@@ -1,0 +1,20 @@
+"""TPU-native pipeline-parallel LLM inference framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference system
+``jwkim-skku/Global_Capstone_Design_Distributed-Inference-of-LLMs-Over-The-Internet``
+(a mini-Petals): staged model partitioning, discovery/placement registry,
+inter-stage activation transfer, per-session KV caches, Petals-paper load
+balancing, and client-side replay-based fault tolerance — re-architected for
+TPUs. Stages are spans of transformer layers mapped to slices of a TPU mesh;
+inter-stage activations move over ICI via collective-permute instead of
+serialized WAN RPC; per-stage KV caches live in preallocated HBM arenas.
+
+Package layout (mirrors reference layer map, SURVEY.md §1):
+  models/    pure-JAX model definitions + HF weight import    (ref src/llama_partition.py)
+  ops/       attention, norms, rotary, sampling, pallas kernels (ref petals/llama/block.py)
+  runtime/   KV arena, stage executor, transport, client loop   (ref src/rpc_handler.py, rpc_transport.py)
+  parallel/  mesh pipeline, TP, ring attention, load balancing  (ref src/load_balancing.py)
+  utils/     config, timing, serialization helpers              (ref src/utils.py)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,164 @@
+"""Model architecture configs for the supported decoder families.
+
+The reference supports the HF ``llama``/``mistral``/``mixtral`` model types plus
+GPT-2 (guards at reference ``src/llama_partition.py:82-93``). Here each family is
+described by one dataclass consumed by a single unified decoder implementation
+(`models.transformer`) instead of family-specific nn.Module classes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters for one decoder-only transformer family."""
+
+    model_type: str  # "gpt2" | "llama" | "mistral" | "mixtral"
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    intermediate_size: int
+    max_position_embeddings: int = 2048
+
+    # Architectural switches
+    norm: str = "rmsnorm"          # "layernorm" (gpt2) | "rmsnorm" (llama family)
+    positional: str = "rope"       # "learned" (gpt2) | "rope"
+    activation: str = "silu"       # "gelu" (gpt2) | "silu"
+    mlp: str = "swiglu"            # "gelu_mlp" (gpt2: fc->act->proj) | "swiglu"
+    use_bias: bool = False         # gpt2 uses biases everywhere; llama none
+    tie_word_embeddings: bool = True
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    sliding_window: Optional[int] = None  # mistral
+
+    # MoE (mixtral)
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    def __post_init__(self):
+        assert self.hidden_size % self.num_heads == 0
+        assert self.num_heads % self.num_kv_heads == 0
+
+
+def gpt2_config(
+    vocab_size: int = 50257,
+    hidden_size: int = 768,
+    num_layers: int = 12,
+    num_heads: int = 12,
+    max_position_embeddings: int = 1024,
+    intermediate_size: Optional[int] = None,
+    norm_eps: float = 1e-5,
+) -> ModelConfig:
+    return ModelConfig(
+        model_type="gpt2",
+        vocab_size=vocab_size,
+        hidden_size=hidden_size,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        num_kv_heads=num_heads,
+        intermediate_size=intermediate_size or 4 * hidden_size,
+        max_position_embeddings=max_position_embeddings,
+        norm="layernorm",
+        positional="learned",
+        activation="gelu",
+        mlp="gelu_mlp",
+        use_bias=True,
+        tie_word_embeddings=True,
+        norm_eps=norm_eps,
+    )
+
+
+def llama_config(
+    vocab_size: int = 32000,
+    hidden_size: int = 4096,
+    num_layers: int = 32,
+    num_heads: int = 32,
+    num_kv_heads: int = 8,
+    intermediate_size: int = 11008,
+    max_position_embeddings: int = 4096,
+    rope_theta: float = 10000.0,
+    tie_word_embeddings: bool = False,
+    norm_eps: float = 1e-5,
+) -> ModelConfig:
+    return ModelConfig(
+        model_type="llama",
+        vocab_size=vocab_size,
+        hidden_size=hidden_size,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        intermediate_size=intermediate_size,
+        max_position_embeddings=max_position_embeddings,
+        norm="rmsnorm",
+        positional="rope",
+        activation="silu",
+        mlp="swiglu",
+        use_bias=False,
+        tie_word_embeddings=tie_word_embeddings,
+        rope_theta=rope_theta,
+        norm_eps=norm_eps,
+    )
+
+
+def mistral_config(sliding_window: Optional[int] = 4096, **kw) -> ModelConfig:
+    cfg = llama_config(**kw)
+    return dataclasses.replace(cfg, model_type="mistral", sliding_window=sliding_window)
+
+
+def mixtral_config(num_experts: int = 8, num_experts_per_tok: int = 2, **kw) -> ModelConfig:
+    cfg = llama_config(**kw)
+    return dataclasses.replace(
+        cfg,
+        model_type="mixtral",
+        num_experts=num_experts,
+        num_experts_per_tok=num_experts_per_tok,
+    )
+
+
+# Named presets mirroring the reference's workload envelope (BASELINE.md).
+PRESETS = {
+    "gpt2": lambda: gpt2_config(),
+    "gpt2-medium": lambda: gpt2_config(hidden_size=1024, num_layers=24, num_heads=16),
+    "gpt2-large": lambda: gpt2_config(hidden_size=1280, num_layers=36, num_heads=20),
+    "gpt2-xl": lambda: gpt2_config(hidden_size=1600, num_layers=48, num_heads=25),
+    "llama-2-7b": lambda: llama_config(num_kv_heads=32),
+    "llama-3-8b": lambda: llama_config(
+        vocab_size=128256, hidden_size=4096, num_layers=32, num_heads=32,
+        num_kv_heads=8, intermediate_size=14336, max_position_embeddings=8192,
+        rope_theta=500000.0,
+    ),
+    "llama-3-70b": lambda: llama_config(
+        vocab_size=128256, hidden_size=8192, num_layers=80, num_heads=64,
+        num_kv_heads=8, intermediate_size=28672, max_position_embeddings=8192,
+        rope_theta=500000.0,
+    ),
+    "mixtral-8x7b": lambda: mixtral_config(
+        vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
+        num_kv_heads=8, intermediate_size=14336,
+    ),
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    key = name.lower().split("/")[-1]
+    if key in PRESETS:
+        return PRESETS[key]()
+    # Longest alias first so "gpt2-xl" resolves to gpt2-xl, not the "gpt2"
+    # substring.
+    for alias in sorted(PRESETS, key=len, reverse=True):
+        if alias in key:
+            return PRESETS[alias]()
+    raise KeyError(f"unknown model preset: {name}")

@@ -1,0 +1,227 @@
+"""Import HuggingFace checkpoints into the stacked-layer JAX param layout.
+
+Capability-parity with two reference paths:
+  * full-checkpoint load + prune to a stage span (``src/llama_partition.py:477-550``
+    loads the whole HF model then deletes layers outside [start, end));
+  * per-block weight streaming (``petals/server/from_pretrained.py:81-128``
+    downloads only the shards containing one block's params).
+
+Here both are the same operation: ``convert_state_dict(..., layer_range)``
+touches only the tensors a stage needs, so a stage never materializes the full
+model in host memory.
+
+Weight-layout notes:
+  * GPT-2 uses Conv1D ([in, out]) — imported as-is; its fused c_attn is split
+    into wq/wk/wv.
+  * LLaMA-family nn.Linear weights are [out, in] — imported transposed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig, gpt2_config, llama_config, mistral_config, mixtral_config
+
+Params = Dict[str, Any]
+
+
+def _np(t) -> np.ndarray:
+    """torch.Tensor | np.ndarray -> np.ndarray (float32 staging)."""
+    if hasattr(t, "detach"):
+        t = t.detach().to("cpu")
+        try:
+            import torch
+
+            if t.dtype == torch.bfloat16:
+                t = t.float()
+        except Exception:
+            pass
+        t = t.numpy()
+    return np.asarray(t)
+
+
+def config_from_hf(hf_cfg) -> ModelConfig:
+    """Build a ModelConfig from a transformers PretrainedConfig."""
+    mt = hf_cfg.model_type
+    if mt == "gpt2":
+        return gpt2_config(
+            vocab_size=hf_cfg.vocab_size,
+            hidden_size=hf_cfg.n_embd,
+            num_layers=hf_cfg.n_layer,
+            num_heads=hf_cfg.n_head,
+            max_position_embeddings=hf_cfg.n_positions,
+            intermediate_size=getattr(hf_cfg, "n_inner", None) or 4 * hf_cfg.n_embd,
+            norm_eps=getattr(hf_cfg, "layer_norm_epsilon", 1e-5),
+        )
+    common = dict(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.hidden_size,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        num_kv_heads=getattr(hf_cfg, "num_key_value_heads", hf_cfg.num_attention_heads),
+        intermediate_size=hf_cfg.intermediate_size,
+        max_position_embeddings=hf_cfg.max_position_embeddings,
+        rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
+        norm_eps=getattr(hf_cfg, "rms_norm_eps", 1e-5),
+    )
+    if mt == "llama":
+        return llama_config(**common)
+    if mt == "mistral":
+        return mistral_config(
+            sliding_window=getattr(hf_cfg, "sliding_window", None), **common
+        )
+    if mt == "mixtral":
+        cfg = mixtral_config(
+            num_experts=hf_cfg.num_local_experts,
+            num_experts_per_tok=hf_cfg.num_experts_per_tok,
+            **common,
+        )
+        sw = getattr(hf_cfg, "sliding_window", None)
+        if sw is not None:
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, sliding_window=sw)
+        return cfg
+    # Mirrors the reference's model_type guard (src/llama_partition.py:82-83).
+    raise ValueError(f"unsupported model_type: {mt} (expected gpt2/llama/mistral/mixtral)")
+
+
+def _gpt2_layer(sd: Mapping[str, Any], i: int) -> Params:
+    pre = f"transformer.h.{i}."
+    c_attn_w = _np(sd[pre + "attn.c_attn.weight"])  # [D, 3D]
+    c_attn_b = _np(sd[pre + "attn.c_attn.bias"])  # [3D]
+    wq, wk, wv = np.split(c_attn_w, 3, axis=1)
+    bq, bk, bv = np.split(c_attn_b, 3, axis=0)
+    return {
+        "ln1": {"w": _np(sd[pre + "ln_1.weight"]), "b": _np(sd[pre + "ln_1.bias"])},
+        "ln2": {"w": _np(sd[pre + "ln_2.weight"]), "b": _np(sd[pre + "ln_2.bias"])},
+        "attn": {
+            "wq": wq, "wk": wk, "wv": wv,
+            "bq": bq, "bk": bk, "bv": bv,
+            "wo": _np(sd[pre + "attn.c_proj.weight"]),
+            "bo": _np(sd[pre + "attn.c_proj.bias"]),
+        },
+        "mlp": {
+            "wi": _np(sd[pre + "mlp.c_fc.weight"]),
+            "bi": _np(sd[pre + "mlp.c_fc.bias"]),
+            "wo": _np(sd[pre + "mlp.c_proj.weight"]),
+            "bo": _np(sd[pre + "mlp.c_proj.bias"]),
+        },
+    }
+
+
+def _llama_layer(sd: Mapping[str, Any], i: int, cfg: ModelConfig) -> Params:
+    pre = f"model.layers.{i}."
+    p: Params = {
+        "ln1": {"w": _np(sd[pre + "input_layernorm.weight"])},
+        "ln2": {"w": _np(sd[pre + "post_attention_layernorm.weight"])},
+        "attn": {
+            "wq": _np(sd[pre + "self_attn.q_proj.weight"]).T,
+            "wk": _np(sd[pre + "self_attn.k_proj.weight"]).T,
+            "wv": _np(sd[pre + "self_attn.v_proj.weight"]).T,
+            "wo": _np(sd[pre + "self_attn.o_proj.weight"]).T,
+        },
+    }
+    if cfg.is_moe:
+        gate = _np(sd[pre + "block_sparse_moe.gate.weight"]).T  # [D, E]
+        wg = np.stack([
+            _np(sd[pre + f"block_sparse_moe.experts.{e}.w1.weight"]).T
+            for e in range(cfg.num_experts)
+        ])
+        wu = np.stack([
+            _np(sd[pre + f"block_sparse_moe.experts.{e}.w3.weight"]).T
+            for e in range(cfg.num_experts)
+        ])
+        wd = np.stack([
+            _np(sd[pre + f"block_sparse_moe.experts.{e}.w2.weight"]).T
+            for e in range(cfg.num_experts)
+        ])
+        p["mlp"] = {"router": gate, "wg": wg, "wu": wu, "wd": wd}
+    else:
+        p["mlp"] = {
+            "wg": _np(sd[pre + "mlp.gate_proj.weight"]).T,
+            "wu": _np(sd[pre + "mlp.up_proj.weight"]).T,
+            "wd": _np(sd[pre + "mlp.down_proj.weight"]).T,
+        }
+    return p
+
+
+def _stack(layer_params: Iterable[Params]) -> Params:
+    layer_params = list(layer_params)
+    return jax.tree.map(lambda *xs: np.stack(xs), *layer_params)
+
+
+def convert_state_dict(
+    cfg: ModelConfig,
+    sd: Mapping[str, Any],
+    dtype=np.float32,
+    layer_range: Optional[Tuple[int, int]] = None,
+    include_embed: bool = True,
+    include_head: bool = True,
+) -> Params:
+    """Convert an HF state_dict to the stacked JAX layout.
+
+    layer_range=(start, end) keeps only that span of layers; include_embed /
+    include_head control whether embedding and final-norm+lm_head tensors are
+    materialized (mirrors the stage-role pruning of
+    ``src/llama_partition.py:506-525``).
+    """
+    start, end = layer_range if layer_range is not None else (0, cfg.num_layers)
+    is_gpt2 = cfg.model_type == "gpt2"
+
+    if is_gpt2:
+        layers = [_gpt2_layer(sd, i) for i in range(start, end)]
+    else:
+        layers = [_llama_layer(sd, i, cfg) for i in range(start, end)]
+
+    params: Params = {}
+    if layers:
+        params["layers"] = jax.tree.map(
+            lambda x: jnp.asarray(x, dtype), _stack(layers)
+        )
+
+    if include_embed:
+        if is_gpt2:
+            embed = {
+                "wte": _np(sd["transformer.wte.weight"]),
+                "wpe": _np(sd["transformer.wpe.weight"]),
+            }
+        else:
+            embed = {"wte": _np(sd["model.embed_tokens.weight"])}
+        params["embed"] = {k: jnp.asarray(v, dtype) for k, v in embed.items()}
+
+    if include_head:
+        if is_gpt2:
+            params["final_norm"] = {
+                "w": jnp.asarray(_np(sd["transformer.ln_f.weight"]), dtype),
+                "b": jnp.asarray(_np(sd["transformer.ln_f.bias"]), dtype),
+            }
+        else:
+            params["final_norm"] = {
+                "w": jnp.asarray(_np(sd["model.norm.weight"]), dtype)
+            }
+        if not cfg.tie_word_embeddings:
+            head = sd.get("lm_head.weight")
+            if head is not None:
+                params["lm_head"] = {"w": jnp.asarray(_np(head).T, dtype)}
+            else:
+                # checkpoint ties embeddings even if config says otherwise
+                key = "transformer.wte.weight" if is_gpt2 else "model.embed_tokens.weight"
+                params["lm_head"] = {"w": jnp.asarray(_np(sd[key]).T, dtype)}
+        if cfg.tie_word_embeddings and not include_embed:
+            # a last-stage shard with tied embeddings still needs wte for the head
+            key = "transformer.wte.weight" if is_gpt2 else "model.embed_tokens.weight"
+            params["embed"] = {"wte": jnp.asarray(_np(sd[key]), dtype)}
+
+    return params
+
+
+def import_hf_model(hf_model, dtype=np.float32) -> Tuple[ModelConfig, Params]:
+    """Convert an in-memory transformers model (e.g. the test oracle)."""
+    cfg = config_from_hf(hf_model.config)
+    return cfg, convert_state_dict(cfg, hf_model.state_dict(), dtype)

@@ -1,0 +1,327 @@
+"""Unified decoder-only transformer, pure JAX, stacked-layer layout.
+
+One implementation covers every family the reference handles (GPT-2 via the
+``transformer.h`` layout, LLaMA/Mistral/Mixtral via ``model.layers`` — see
+reference ``src/llama_partition.py:82-93,151-156``), switched by `ModelConfig`
+rather than per-family nn.Module classes.
+
+TPU-first design decisions:
+  * Per-layer parameters are STACKED along a leading layer axis and the layer
+    loop is ``lax.scan`` — one trace/compile regardless of how many layers a
+    stage holds, and XLA pipelines the weight loads.
+  * KV caches are static-shape arrays written by ``dynamic_update_slice``
+    (ops.attention) — replaces the reference's growing legacy tuples
+    (``src/utils.py:51-64``).
+  * Optional tensor parallelism: pass ``tp_axis`` inside ``shard_map`` — q/k/v
+    and mlp-in projections consume head-/ffn-sharded weights, and the out
+    projections finish with ``lax.psum`` over the axis.
+
+Matmul convention: all weights are stored [in, out] so HF GPT-2 Conv1D weights
+import directly and HF Linear weights import transposed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import cached_attention, update_kv_cache
+from ..ops.norms import layer_norm, rms_norm
+from ..ops.rotary import apply_rope, rope_cos_sin
+from .config import ModelConfig
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+def _dense(rng, shape, dtype, scale=0.02):
+    return (scale * jax.random.normal(rng, shape, jnp.float32)).astype(dtype)
+
+
+def init_layer_params(rng: jax.Array, cfg: ModelConfig, dtype=jnp.float32) -> Params:
+    """Random init for ONE layer (no leading layer axis)."""
+    d, i = cfg.hidden_size, cfg.intermediate_size
+    h, hkv, dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    ks = jax.random.split(rng, 12)
+    p: Params = {
+        "attn": {
+            "wq": _dense(ks[0], (d, h * dh), dtype),
+            "wk": _dense(ks[1], (d, hkv * dh), dtype),
+            "wv": _dense(ks[2], (d, hkv * dh), dtype),
+            "wo": _dense(ks[3], (h * dh, d), dtype),
+        },
+    }
+    if cfg.norm == "layernorm":
+        p["ln1"] = {"w": jnp.ones((d,), dtype), "b": jnp.zeros((d,), dtype)}
+        p["ln2"] = {"w": jnp.ones((d,), dtype), "b": jnp.zeros((d,), dtype)}
+    else:
+        p["ln1"] = {"w": jnp.ones((d,), dtype)}
+        p["ln2"] = {"w": jnp.ones((d,), dtype)}
+    if cfg.use_bias:
+        p["attn"]["bq"] = jnp.zeros((h * dh,), dtype)
+        p["attn"]["bk"] = jnp.zeros((hkv * dh,), dtype)
+        p["attn"]["bv"] = jnp.zeros((hkv * dh,), dtype)
+        p["attn"]["bo"] = jnp.zeros((d,), dtype)
+    if cfg.is_moe:
+        e = cfg.num_experts
+        p["mlp"] = {
+            "router": _dense(ks[4], (d, e), dtype),
+            "wg": _dense(ks[5], (e, d, i), dtype),
+            "wu": _dense(ks[6], (e, d, i), dtype),
+            "wd": _dense(ks[7], (e, i, d), dtype),
+        }
+    elif cfg.mlp == "swiglu":
+        p["mlp"] = {
+            "wg": _dense(ks[5], (d, i), dtype),
+            "wu": _dense(ks[6], (d, i), dtype),
+            "wd": _dense(ks[7], (i, d), dtype),
+        }
+    else:  # gelu_mlp (gpt2)
+        p["mlp"] = {
+            "wi": _dense(ks[5], (d, i), dtype),
+            "wo": _dense(ks[6], (i, d), dtype),
+        }
+        if cfg.use_bias:
+            p["mlp"]["bi"] = jnp.zeros((i,), dtype)
+            p["mlp"]["bo"] = jnp.zeros((d,), dtype)
+    return p
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig, dtype=jnp.float32) -> Params:
+    """Random init of the FULL model with stacked layers."""
+    k_emb, k_layers, k_head = jax.random.split(rng, 3)
+    layer_keys = jax.random.split(k_layers, cfg.num_layers)
+    layers = jax.vmap(lambda k: init_layer_params(k, cfg, dtype))(layer_keys)
+
+    embed: Params = {"wte": _dense(k_emb, (cfg.vocab_size, cfg.hidden_size), dtype)}
+    if cfg.positional == "learned":
+        embed["wpe"] = _dense(
+            jax.random.fold_in(k_emb, 1),
+            (cfg.max_position_embeddings, cfg.hidden_size),
+            dtype,
+        )
+
+    if cfg.norm == "layernorm":
+        final_norm = {
+            "w": jnp.ones((cfg.hidden_size,), dtype),
+            "b": jnp.zeros((cfg.hidden_size,), dtype),
+        }
+    else:
+        final_norm = {"w": jnp.ones((cfg.hidden_size,), dtype)}
+
+    params: Params = {"embed": embed, "layers": layers, "final_norm": final_norm}
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"w": _dense(k_head, (cfg.hidden_size, cfg.vocab_size), dtype)}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def embed_tokens(cfg: ModelConfig, embed: Params, input_ids: jnp.ndarray,
+                 positions: jnp.ndarray) -> jnp.ndarray:
+    """input_ids: [B, T] int32; positions: [B, T] int32 -> hidden [B, T, D]."""
+    h = jnp.take(embed["wte"], input_ids, axis=0)
+    if cfg.positional == "learned":
+        # Clip keeps the gather in-bounds under jit; generating past
+        # max_position_embeddings must be rejected by session-level max-length
+        # admission control (runtime.kv_cache), not here — same contract as
+        # update_kv_cache.
+        pos = jnp.clip(positions, 0, cfg.max_position_embeddings - 1)
+        h = h + jnp.take(embed["wpe"], pos, axis=0)
+    return h
+
+
+def _psum_if(x: jnp.ndarray, tp_axis: Optional[str]) -> jnp.ndarray:
+    return jax.lax.psum(x, tp_axis) if tp_axis is not None else x
+
+
+def _mlp(cfg: ModelConfig, p: Params, x: jnp.ndarray, tp_axis: Optional[str]) -> jnp.ndarray:
+    if cfg.is_moe:
+        return _moe_mlp(cfg, p, x, tp_axis)
+    if cfg.mlp == "swiglu":
+        gate = jax.nn.silu(x @ p["wg"])
+        up = x @ p["wu"]
+        return _psum_if((gate * up) @ p["wd"], tp_axis)
+    y = x @ p["wi"]
+    if "bi" in p:
+        y = y + p["bi"]
+    y = jax.nn.gelu(y, approximate=True)  # gpt2 uses gelu_new (tanh approx)
+    y = _psum_if(y @ p["wo"], tp_axis)
+    if "bo" in p:
+        y = y + p["bo"]
+    return y
+
+
+def _moe_mlp(cfg: ModelConfig, p: Params, x: jnp.ndarray, tp_axis: Optional[str]) -> jnp.ndarray:
+    """Mixtral-style top-k routed SwiGLU experts.
+
+    Dense formulation: every expert runs on every token and the router weights
+    zero out the non-selected ones. All-expert einsums keep the MXU busy with
+    static shapes; token-dropping dispatch is a later optimization (the
+    reference has no runnable MoE at all — only config guards,
+    ``src/llama_partition.py:82``).
+    """
+    router_logits = x.astype(jnp.float32) @ p["router"].astype(jnp.float32)  # [B,T,E]
+    topv, topi = jax.lax.top_k(router_logits, cfg.num_experts_per_tok)
+    weights = jax.nn.softmax(topv, axis=-1)  # normalized over selected experts
+    # scatter normalized weights back to a dense [B,T,E] map
+    dense_w = jnp.zeros_like(router_logits)
+    b, t, _ = router_logits.shape
+    dense_w = dense_w.at[
+        jnp.arange(b)[:, None, None],
+        jnp.arange(t)[None, :, None],
+        topi,
+    ].set(weights)
+
+    gate = jax.nn.silu(jnp.einsum("btd,edi->btei", x, p["wg"]))
+    up = jnp.einsum("btd,edi->btei", x, p["wu"])
+    per_expert = jnp.einsum("btei,eid->bted", gate * up, p["wd"])
+    out = jnp.einsum("bted,bte->btd", per_expert, dense_w.astype(x.dtype))
+    return _psum_if(out, tp_axis)
+
+
+def make_rope(cfg: ModelConfig, positions: jnp.ndarray):
+    """cos/sin tables for a batch of positions, or None for non-RoPE models.
+
+    Computed ONCE per forward and threaded through every layer — inside a
+    lax.scan body XLA won't hoist the transcendentals, so recomputing per
+    layer would cost num_layers rebuilds (80x for llama-3-70b)."""
+    if cfg.positional != "rope":
+        return None
+    return rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+
+def _attention(
+    cfg: ModelConfig,
+    p: Params,
+    x: jnp.ndarray,
+    rope,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    cache_len: jnp.ndarray,
+    tp_axis: Optional[str],
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    b, t, _ = x.shape
+    dh = cfg.head_dim
+    q = x @ p["wq"]
+    k = x @ p["wk"]
+    v = x @ p["wv"]
+    if "bq" in p:
+        q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+    h_local = q.shape[-1] // dh
+    hkv_local = k.shape[-1] // dh
+    q = q.reshape(b, t, h_local, dh)
+    k = k.reshape(b, t, hkv_local, dh)
+    v = v.reshape(b, t, hkv_local, dh)
+
+    if rope is not None:
+        cos, sin = rope
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+    k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, cache_len)
+    out = cached_attention(
+        q, k_cache, v_cache, cache_len, sliding_window=cfg.sliding_window
+    )
+    y = out.reshape(b, t, h_local * dh) @ p["wo"]
+    y = _psum_if(y, tp_axis)
+    if "bo" in p:
+        y = y + p["bo"]
+    return y, k_cache, v_cache
+
+
+def _norm(cfg: ModelConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.norm == "layernorm":
+        return layer_norm(x, p["w"], p["b"], cfg.norm_eps)
+    return rms_norm(x, p["w"], cfg.norm_eps)
+
+
+def layer_forward(
+    cfg: ModelConfig,
+    p: Params,
+    x: jnp.ndarray,
+    rope,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    cache_len: jnp.ndarray,
+    tp_axis: Optional[str] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pre-norm residual block. x: [B,T,D] -> ([B,T,D], new k/v cache).
+
+    rope: (cos, sin) from `make_rope`, or None for learned-position models.
+    """
+    attn_out, k_cache, v_cache = _attention(
+        cfg, p["attn"], _norm(cfg, p["ln1"], x), rope, k_cache, v_cache,
+        cache_len, tp_axis,
+    )
+    x = x + attn_out
+    x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["ln2"], x), tp_axis)
+    return x, k_cache, v_cache
+
+
+def stack_forward(
+    cfg: ModelConfig,
+    layers: Params,
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    k_caches: jnp.ndarray,
+    v_caches: jnp.ndarray,
+    cache_len: jnp.ndarray,
+    tp_axis: Optional[str] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run a span of stacked layers via lax.scan.
+
+    layers: pytree with leading layer axis L. k_caches/v_caches: [L,B,S,Hkv,Dh].
+    """
+    rope = make_rope(cfg, positions)
+
+    def body(h, xs):
+        lp, kc, vc = xs
+        h, kc, vc = layer_forward(cfg, lp, h, rope, kc, vc, cache_len, tp_axis)
+        return h, (kc, vc)
+
+    x, (k_caches, v_caches) = jax.lax.scan(body, x, (layers, k_caches, v_caches))
+    return x, k_caches, v_caches
+
+
+def lm_head(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Final norm + projection to vocab. x: [B,T,D] -> [B,T,V] float32."""
+    x = _norm(cfg, params["final_norm"], x)
+    if cfg.tie_word_embeddings:
+        w = params["embed"]["wte"].T
+    else:
+        w = params["lm_head"]["w"]
+    return (x.astype(jnp.float32) @ w.astype(jnp.float32))
+
+
+def init_kv_cache(
+    cfg: ModelConfig, num_layers: int, batch: int, max_len: int, dtype=jnp.float32
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    shape = (num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def full_forward(
+    cfg: ModelConfig,
+    params: Params,
+    input_ids: jnp.ndarray,
+    k_caches: jnp.ndarray,
+    v_caches: jnp.ndarray,
+    cache_len: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Whole unpartitioned model (the single-device oracle path, mirroring
+    reference ``scripts/single_gpu_check.py``). Returns (logits, new caches)."""
+    b, t = input_ids.shape
+    positions = cache_len + jnp.arange(t, dtype=jnp.int32)[None, :]
+    x = embed_tokens(cfg, params["embed"], input_ids, positions)
+    x, k_caches, v_caches = stack_forward(
+        cfg, params["layers"], x, positions, k_caches, v_caches, cache_len
+    )
+    return lm_head(cfg, params, x), k_caches, v_caches

@@ -1,0 +1,28 @@
+from .attention import cached_attention, update_kv_cache
+from .norms import layer_norm, rms_norm
+from .rotary import apply_rope, rope_cos_sin
+from .sampling import (
+    RECENT_WINDOW,
+    SamplingParams,
+    apply_repetition_penalty,
+    make_recent_buffer,
+    push_recent,
+    sample_probs,
+    sample_token,
+)
+
+__all__ = [
+    "cached_attention",
+    "update_kv_cache",
+    "layer_norm",
+    "rms_norm",
+    "apply_rope",
+    "rope_cos_sin",
+    "RECENT_WINDOW",
+    "SamplingParams",
+    "apply_repetition_penalty",
+    "make_recent_buffer",
+    "push_recent",
+    "sample_probs",
+    "sample_token",
+]

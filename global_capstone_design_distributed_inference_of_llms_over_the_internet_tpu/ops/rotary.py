@@ -1,0 +1,51 @@
+"""Rotary position embeddings (RoPE).
+
+Functional equivalent of the reference's explicit rotary implementation
+(``petals/llama/block.py:33-36,96-121``), which CUDA-graphs the q_len==1 decode
+case; under XLA the jitted decode step already amortizes launch overhead, so a
+single traced implementation covers prefill and decode.
+
+Uses the HF "half-rotation" layout (rotate_half) so imported checkpoints match
+numerically.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jnp.ndarray:
+    """Inverse frequencies, shape [head_dim // 2], float32."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta ** exponents)
+
+
+def rope_cos_sin(positions: jnp.ndarray, head_dim: int, theta: float):
+    """cos/sin tables for integer positions.
+
+    positions: int array [...]; returns (cos, sin) each [..., head_dim] float32,
+    with the HF duplicated-half layout: angles = concat([freqs*pos, freqs*pos]).
+    """
+    inv_freq = rope_frequencies(head_dim, theta)
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., hd/2]
+    angles = jnp.concatenate([angles, angles], axis=-1)  # [..., hd]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Apply RoPE to q or k.
+
+    x: [B, T, H, Dh]; cos/sin: [B, T, Dh] (or broadcastable). Computed in
+    float32 and cast back to x.dtype.
+    """
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    c = cos[..., None, :]  # [B, T, 1, Dh]
+    s = sin[..., None, :]
+    return (x32 * c + _rotate_half(x32) * s).astype(dtype)

@@ -1,0 +1,163 @@
+"""Token sampling with reference-parity semantics, fully jittable.
+
+Mirrors the server-side sampler of the reference (``src/rpc_handler.py:327-403``),
+which runs ON THE FINAL STAGE (sampling params travel in request metadata):
+
+  1. temperature <= 0  -> greedy argmax.
+  2. count-scaled repetition penalty over the last 50 generated tokens:
+     penalty = rp ** count(token); positive logits are divided, negative
+     multiplied (sign-aware, ``rpc_handler.py:343-359``).
+  3. triple-repeat guard: if the last 3 generated tokens are identical, apply a
+     strong rp**3 penalty to that token (``:361-372``).
+  4. probs = softmax(logits / max(temperature, 1e-5)).
+  5. top-k filter on probs (unrenormalized zero-out, ``:376-382``).
+  6. top-p nucleus on the sorted probs: keep cumsum <= top_p, always keep the
+     first, renormalize the kept mass (``:384-396``).
+  7. renormalize and sample.
+
+Differences by design (TPU): the "recent tokens" window is a fixed-size int32
+ring buffer so the whole sampler is one compiled XLA program with static
+shapes; ties at the top-k boundary keep all tied entries (sort-threshold
+instead of an exact-k gather) — measure-zero for real logits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+RECENT_WINDOW = 50  # reference: generated_tokens[-50:]
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-session sampling config; travels in request metadata like the
+    reference wire protocol (SURVEY.md Appendix B)."""
+
+    temperature: float = 0.7
+    top_p: float = 0.9
+    top_k: int = 50
+    repetition_penalty: float = 1.5  # reference default, rpc_handler.py:164
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+def make_recent_buffer() -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Empty recent-token buffer: (tokens[RECENT_WINDOW], num_valid)."""
+    return jnp.zeros((RECENT_WINDOW,), jnp.int32), jnp.zeros((), jnp.int32)
+
+
+def push_recent(tokens: jnp.ndarray, num_valid: jnp.ndarray, new_token: jnp.ndarray):
+    """Append a token, shifting left once the window is full (jittable)."""
+    full = num_valid >= RECENT_WINDOW
+    shifted = jnp.where(full, jnp.roll(tokens, -1), tokens)
+    idx = jnp.where(full, RECENT_WINDOW - 1, num_valid)
+    tokens = shifted.at[idx].set(new_token.astype(jnp.int32))
+    return tokens, jnp.minimum(num_valid + 1, RECENT_WINDOW)
+
+
+def apply_repetition_penalty(
+    logits: jnp.ndarray,
+    recent_tokens: jnp.ndarray,
+    num_valid: jnp.ndarray,
+    repetition_penalty: jnp.ndarray,
+) -> jnp.ndarray:
+    """Count-scaled, sign-aware repetition penalty over the recent window.
+
+    logits: [V] float32. recent_tokens: [RECENT_WINDOW] int32 (newest last).
+    """
+    vocab = logits.shape[-1]
+    valid = jnp.arange(recent_tokens.shape[0]) < num_valid
+    safe = jnp.where(valid, recent_tokens, 0)
+    counts = jnp.zeros((vocab,), jnp.float32).at[safe].add(valid.astype(jnp.float32))
+
+    penalty = repetition_penalty ** counts
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    logits = jnp.where(counts > 0, penalized, logits)
+
+    # Triple-repeat strong penalty (rp**3) on the token repeated 3x in a row.
+    n = num_valid
+    t1 = recent_tokens[jnp.clip(n - 1, 0, RECENT_WINDOW - 1)]
+    t2 = recent_tokens[jnp.clip(n - 2, 0, RECENT_WINDOW - 1)]
+    t3 = recent_tokens[jnp.clip(n - 3, 0, RECENT_WINDOW - 1)]
+    is_triple = (n >= 3) & (t1 == t2) & (t2 == t3)
+    strong = repetition_penalty ** 3
+    cur = logits[t1]
+    hit = jnp.where(cur > 0, cur / strong, cur * strong)
+    return logits.at[t1].set(jnp.where(is_triple, hit, cur))
+
+
+def _top_k_filter(probs: jnp.ndarray, top_k: jnp.ndarray) -> jnp.ndarray:
+    vocab = probs.shape[-1]
+    sorted_desc = jnp.sort(probs, axis=-1)[::-1]
+    kth = sorted_desc[jnp.clip(top_k - 1, 0, vocab - 1)]
+    apply = (top_k > 0) & (top_k < vocab)
+    return jnp.where(apply & (probs < kth), 0.0, probs)
+
+
+def _top_p_filter(probs: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    order = jnp.argsort(-probs, axis=-1)
+    sorted_probs = probs[order]
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    keep = cum <= top_p
+    keep = keep.at[0].set(True)
+    filtered = sorted_probs * keep
+    filtered = filtered / jnp.maximum(filtered.sum(), 1e-20)
+    scattered = jnp.zeros_like(probs).at[order].set(filtered)
+    apply = (top_p > 0.0) & (top_p < 1.0)
+    return jnp.where(apply, scattered, probs)
+
+
+def sample_probs(
+    logits: jnp.ndarray,
+    recent_tokens: jnp.ndarray,
+    num_valid: jnp.ndarray,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    top_k: jnp.ndarray,
+    repetition_penalty: jnp.ndarray,
+) -> jnp.ndarray:
+    """Final categorical distribution after penalty + temp + top-k + top-p.
+
+    logits: [V]. Returns probs [V] summing to 1 (greedy handled by caller).
+    """
+    logits = logits.astype(jnp.float32)
+    apply_rp = (repetition_penalty != 1.0) & (num_valid > 0)
+    logits = jnp.where(
+        apply_rp,
+        apply_repetition_penalty(logits, recent_tokens, num_valid, repetition_penalty),
+        logits,
+    )
+    temp = jnp.maximum(temperature, 1e-5)
+    probs = jax.nn.softmax(logits / temp, axis=-1)
+    probs = _top_k_filter(probs, top_k)
+    probs = _top_p_filter(probs, top_p)
+    return probs / jnp.maximum(probs.sum(), 1e-20)
+
+
+def sample_token(
+    rng: jax.Array,
+    logits: jnp.ndarray,
+    recent_tokens: jnp.ndarray,
+    num_valid: jnp.ndarray,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    top_k: jnp.ndarray,
+    repetition_penalty: jnp.ndarray,
+) -> jnp.ndarray:
+    """One compiled sampling step. logits: [V] -> scalar int32 token.
+
+    All knobs are traced scalars so every (temperature, top_p, top_k, rp)
+    combination reuses one executable.
+    """
+    probs = sample_probs(
+        logits, recent_tokens, num_valid, temperature, top_p, top_k, repetition_penalty
+    )
+    sampled = jax.random.categorical(rng, jnp.log(jnp.maximum(probs, 1e-20)))
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)

@@ -1,0 +1,168 @@
+"""Golden-oracle tests: our JAX models vs transformers (torch, random weights).
+
+The reference's only correctness check was a manual single-GPU comparison
+script (``scripts/single_gpu_check.py``); here the same idea is an automated
+assertion: identical weights -> logits allclose and greedy tokens identical,
+including incremental decode through the KV cache.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+import torch
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+    config_from_hf,
+    convert_state_dict,
+    full_forward,
+    init_kv_cache,
+)
+
+def tiny_gpt2():
+    torch.manual_seed(0)
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    hf_cfg = GPT2Config(
+        vocab_size=257, n_embd=64, n_layer=4, n_head=4, n_positions=128,
+    )
+    return GPT2LMHeadModel(hf_cfg).eval()
+
+
+def tiny_llama():
+    torch.manual_seed(0)
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=320, hidden_size=64, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=128,
+        max_position_embeddings=128, rope_theta=10000.0, tie_word_embeddings=False,
+    )
+    return LlamaForCausalLM(hf_cfg).eval()
+
+
+def tiny_mistral():
+    torch.manual_seed(0)
+    from transformers import MistralConfig, MistralForCausalLM
+
+    hf_cfg = MistralConfig(
+        vocab_size=320, hidden_size=64, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=128,
+        max_position_embeddings=128, sliding_window=8,
+    )
+    return MistralForCausalLM(hf_cfg).eval()
+
+
+def tiny_mixtral():
+    torch.manual_seed(0)
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    hf_cfg = MixtralConfig(
+        vocab_size=320, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+        max_position_embeddings=128, num_local_experts=4, num_experts_per_tok=2,
+    )
+    return MixtralForCausalLM(hf_cfg).eval()
+
+
+FACTORIES = {
+    "gpt2": tiny_gpt2,
+    "llama": tiny_llama,
+    "mistral": tiny_mistral,
+    "mixtral": tiny_mixtral,
+}
+
+
+@pytest.mark.parametrize("family", list(FACTORIES))
+def test_prefill_logits_match_hf(family):
+    hf_model = FACTORIES[family]()
+    cfg = config_from_hf(hf_model.config)
+    params = convert_state_dict(cfg, hf_model.state_dict())
+
+    ids = np.array([[5, 9, 23, 7, 81, 2, 14, 3]], dtype=np.int32)
+    with torch.no_grad():
+        ref_logits = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+
+    kc, vc = init_kv_cache(cfg, cfg.num_layers, batch=1, max_len=32)
+    logits, _, _ = full_forward(
+        cfg, params, jnp.asarray(ids), kc, vc, jnp.int32(0)
+    )
+    if family == "mixtral":
+        # Random-weight routers produce near-tied top-k gaps (observed 5e-4);
+        # fp noise then flips expert choice for a token, shifting its logits
+        # by ~2e-2. Accept that while still requiring argmax agreement.
+        atol, rtol = 5e-2, 5e-2
+    else:
+        atol, rtol = 8e-3, 1e-2
+    np.testing.assert_allclose(np.asarray(logits), ref_logits, atol=atol, rtol=rtol)
+    assert (np.asarray(logits).argmax(-1) == ref_logits.argmax(-1)).all()
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_incremental_decode_matches_full_recompute(family):
+    """Prefill + per-token decode through the KV cache must equal one full
+    forward over the whole sequence (the cache is exact, not approximate)."""
+    hf_model = FACTORIES[family]()
+    cfg = config_from_hf(hf_model.config)
+    params = convert_state_dict(cfg, hf_model.state_dict())
+
+    full_ids = np.array([[5, 9, 23, 7, 81, 2, 14, 3, 19, 44]], dtype=np.int32)
+    prompt_len = 6
+
+    kc, vc = init_kv_cache(cfg, cfg.num_layers, batch=1, max_len=16)
+    logits, kc, vc = full_forward(
+        cfg, params, jnp.asarray(full_ids[:, :prompt_len]), kc, vc, jnp.int32(0)
+    )
+    step_logits = [np.asarray(logits[:, -1])]
+    for t in range(prompt_len, full_ids.shape[1]):
+        logits, kc, vc = full_forward(
+            cfg, params, jnp.asarray(full_ids[:, t : t + 1]), kc, vc, jnp.int32(t)
+        )
+        step_logits.append(np.asarray(logits[:, -1]))
+
+    kc2, vc2 = init_kv_cache(cfg, cfg.num_layers, batch=1, max_len=16)
+    ref_logits, _, _ = full_forward(
+        cfg, params, jnp.asarray(full_ids), kc2, vc2, jnp.int32(0)
+    )
+    for i, sl in enumerate(step_logits):
+        pos = prompt_len - 1 + i
+        np.testing.assert_allclose(
+            sl, np.asarray(ref_logits[:, pos]), atol=5e-3, rtol=5e-3,
+            err_msg=f"mismatch at position {pos}",
+        )
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama", "mistral"])
+def test_greedy_generation_token_identical(family):
+    """End-to-end greedy decode vs transformers .generate — token identical."""
+    hf_model = FACTORIES[family]()
+    cfg = config_from_hf(hf_model.config)
+    params = convert_state_dict(cfg, hf_model.state_dict())
+
+    prompt = np.array([[5, 9, 23, 7]], dtype=np.int32)
+    n_new = 12
+    with torch.no_grad():
+        ref = hf_model.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=n_new, do_sample=False, use_cache=True,
+            pad_token_id=0,
+        ).numpy()[0, prompt.shape[1]:]
+
+    kc, vc = init_kv_cache(cfg, cfg.num_layers, batch=1, max_len=32)
+    logits, kc, vc = full_forward(
+        cfg, params, jnp.asarray(prompt), kc, vc, jnp.int32(0)
+    )
+    out = []
+    cur = int(jnp.argmax(logits[0, -1]))
+    out.append(cur)
+    cache_len = prompt.shape[1]
+    for _ in range(n_new - 1):
+        logits, kc, vc = full_forward(
+            cfg, params, jnp.asarray([[cur]], dtype=jnp.int32), kc, vc,
+            jnp.int32(cache_len),
+        )
+        cur = int(jnp.argmax(logits[0, -1]))
+        out.append(cur)
+        cache_len += 1
+
+    assert out == list(ref), f"ours={out} ref={list(ref)}"
