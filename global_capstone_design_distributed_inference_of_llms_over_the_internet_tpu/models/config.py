@@ -156,9 +156,13 @@ def get_config(name: str) -> ModelConfig:
     key = name.lower().split("/")[-1]
     if key in PRESETS:
         return PRESETS[key]()
-    # Longest alias first so "gpt2-xl" resolves to gpt2-xl, not the "gpt2"
-    # substring.
+    # Longest alias first so "meta-llama-3-8b" resolves to llama-3-8b, not the
+    # "llama-3" prefix of a shorter alias. The alias must appear as a
+    # delimiter-bounded token: "distilgpt2" must NOT resolve to gpt2 (different
+    # architecture), while "meta-llama-3-8b" and "gpt2_finetuned" do resolve.
+    import re
+
     for alias in sorted(PRESETS, key=len, reverse=True):
-        if alias in key:
+        if re.search(rf"(^|[^a-z0-9]){re.escape(alias)}([^a-z0-9]|$)", key):
             return PRESETS[alias]()
     raise KeyError(f"unknown model preset: {name}")

@@ -1,0 +1,129 @@
+"""Partition layer: a pipeline of stage forwards must equal the full model.
+
+The reference never asserted this (its check was eyeballing a single-GPU run,
+``scripts/single_gpu_check.py``); here it is exact: same params, split into
+stage shards, run stage-by-stage -> logits identical to full_forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+    full_forward,
+    gpt2_config,
+    init_kv_cache,
+    init_params,
+    llama_config,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.partition import (
+    StagePlan,
+    init_stage_kv,
+    parse_splits,
+    plan_forward,
+    slice_stage_params,
+)
+
+
+def tiny_cfg(family):
+    if family == "gpt2":
+        return gpt2_config(vocab_size=257, hidden_size=64, num_layers=8,
+                           num_heads=4, max_position_embeddings=64)
+    return llama_config(vocab_size=257, hidden_size=64, num_layers=8,
+                        num_heads=4, num_kv_heads=2, intermediate_size=128,
+                        max_position_embeddings=64)
+
+
+def test_from_splits_matches_reference_cli_semantics():
+    plan = StagePlan.from_splits(12, parse_splits("4,8,10"))
+    assert [(s.start, s.end) for s in plan.stages] == [(0, 4), (4, 8), (8, 10), (10, 12)]
+    assert plan.stages[0].is_first and plan.stages[-1].is_last
+    assert [s.role for s in plan.stages] == ["stage0", "segment", "segment", "last"]
+
+
+def test_even_plan_covers_all_layers():
+    plan = StagePlan.even(13, 4)
+    assert sum(s.num_layers for s in plan.stages) == 13
+    assert plan.stages[0].start == 0 and plan.stages[-1].end == 13
+
+
+def test_single_stage_plan_is_both_first_and_last():
+    plan = StagePlan.even(8, 1)
+    (s,) = plan.stages
+    assert s.is_first and s.is_last
+    cfg = tiny_cfg("llama")
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    sp = slice_stage_params(cfg, params, plan.stages[0])
+    ids = jnp.asarray([[5, 9, 23]], dtype=jnp.int32)
+    kvs = [init_stage_kv(cfg, plan.stages[0], 1, 16)]
+    logits, _ = plan_forward(cfg, plan, [sp], ids, kvs, jnp.int32(0))
+    assert logits.shape == (1, 3, cfg.vocab_size)  # head applied, not hidden
+
+
+def test_get_config_alias_boundaries():
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.config import get_config
+
+    assert get_config("meta-llama/Meta-Llama-3-8B").vocab_size == 128256
+    assert get_config("openai-community/gpt2").hidden_size == 768
+    with pytest.raises(KeyError):
+        get_config("distilgpt2")  # different architecture, must not match gpt2
+
+
+def test_bad_splits_rejected():
+    with pytest.raises(AssertionError):
+        StagePlan.from_splits(8, [6, 4])
+    with pytest.raises(AssertionError):
+        StagePlan.from_splits(8, [0, 4])
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+@pytest.mark.parametrize("splits", ["3,6", "2,4,6"])
+def test_staged_pipeline_equals_full_forward(family, splits):
+    cfg = tiny_cfg(family)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits(splits))
+    stage_params = [slice_stage_params(cfg, params, s) for s in plan.stages]
+
+    ids = jnp.asarray([[5, 9, 23, 7, 81, 2]], dtype=jnp.int32)
+    max_len = 16
+
+    kc, vc = init_kv_cache(cfg, cfg.num_layers, 1, max_len)
+    ref_logits, ref_kc, ref_vc = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
+
+    kvs = [init_stage_kv(cfg, s, 1, max_len) for s in plan.stages]
+    logits, new_kvs = plan_forward(cfg, plan, stage_params, ids, kvs, jnp.int32(0))
+
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               atol=2e-4, rtol=2e-4)
+    # staged KV caches concatenated over stages == full-model caches
+    cat_k = jnp.concatenate([kv[0] for kv in new_kvs], axis=0)
+    cat_v = jnp.concatenate([kv[1] for kv in new_kvs], axis=0)
+    np.testing.assert_allclose(np.asarray(cat_k), np.asarray(ref_kc),
+                               atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(cat_v), np.asarray(ref_vc),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_stage0_decode_step_after_prefill():
+    cfg = tiny_cfg("llama")
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    plan = StagePlan.from_splits(cfg.num_layers, [3, 6])
+    stage_params = [slice_stage_params(cfg, params, s) for s in plan.stages]
+
+    ids = jnp.asarray([[5, 9, 23, 7]], dtype=jnp.int32)
+    max_len = 16
+    kvs = [init_stage_kv(cfg, s, 1, max_len) for s in plan.stages]
+
+    logits, kvs = plan_forward(cfg, plan, stage_params, ids, kvs, jnp.int32(0))
+    nxt = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+    logits2, kvs = plan_forward(cfg, plan, stage_params, nxt, kvs, jnp.int32(4))
+
+    # oracle: full model, same two steps
+    kc, vc = init_kv_cache(cfg, cfg.num_layers, 1, max_len)
+    rl, kc, vc = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
+    rn = jnp.argmax(rl[:, -1:], axis=-1).astype(jnp.int32)
+    rl2, kc, vc = full_forward(cfg, params, rn, kc, vc, jnp.int32(4))
+    assert int(nxt[0, 0]) == int(rn[0, 0])
+    np.testing.assert_allclose(np.asarray(logits2), np.asarray(rl2),
+                               atol=2e-4, rtol=2e-4)
