@@ -1,0 +1,441 @@
+"""Pipeline client: routing, journaled fault tolerance, generation loop.
+
+TPU-native counterpart of the reference client stack:
+
+  * ``run_rank0`` generation loop (``src/main.py:62-227``): tokenized prompt →
+    local stage0 forward → remote pipeline walk → sampled token back from the
+    final stage; EOS + 5×-repeat stopping; TTFT/decode metrics.
+  * ``RpcTransport`` routing (``src/rpc_transport.py:393-501``): fixed
+    stage-chain route, or greedy module route over block coverage (pick the
+    candidate covering the next uncovered block with the largest
+    ``end_block``, tie-break throughput; verify the last hop serves the final
+    stage).
+  * fault tolerance (``src/rpc_transport.py:587-712``): every activation sent
+    to a remote stage is journaled; on failure the client marks the peer
+    failed, re-discovers a replacement (excluding failed peers), REPLAYS the
+    journal to rebuild the replacement's KV cache, and retries — at most 3
+    attempts per call.
+
+The journal is bounded per session by ``journal_max_entries`` (the reference
+journals unboundedly, ``src/rpc_transport.py:106`` — a noted memory hazard;
+SURVEY.md §7.3 hard part 4): when the bound is hit, the two oldest entries are
+coalesced by concatenating along the sequence axis, which keeps replay exact
+while capping Python-object overhead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.config import ModelConfig
+from ..models.partition import StagePlan, StageSpec
+from ..ops.sampling import SamplingParams
+from ..scheduling.registry import PlacementRegistry, ServerRecord
+from .executor import StageExecutionError, StageExecutor
+from .messages import StageRequest, StageResponse, clip_generated
+from .transport import PeerUnavailable, Transport
+
+logger = logging.getLogger(__name__)
+
+MAX_ATTEMPTS = 3          # src/rpc_transport.py:597
+SETTLE_SECONDS = 0.2      # src/rpc_transport.py:657
+REPEAT_STOP = 5           # 5 consecutive identical tokens, src/main.py:197-204
+# A coalesced replay chunk must stay replayable: the executor pads sequences
+# up to SEQ_BUCKETS whose largest entry is 8192.
+MAX_COALESCED_TOKENS = 4096
+
+
+class NoRouteError(RuntimeError):
+    """No live servers cover the required span (route computation failed)."""
+
+
+@dataclasses.dataclass
+class Hop:
+    """One remote hop of the route: a pinned peer serving [start, end)."""
+
+    key: str                 # stable hop identity ("stage1" / "blocks8:16")
+    peer_id: str
+    start_block: int
+    end_block: int
+    expect_token: bool       # final hop returns a sampled token
+
+
+@dataclasses.dataclass
+class JournalEntry:
+    hidden: np.ndarray       # [B, T, D] activation as sent
+    seq_len: int
+    cur_len: int             # session length before this entry
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    tokens: List[int]
+    ttft_s: float
+    decode_times_s: List[float]
+    stopped_by: str          # "eos" | "repeat" | "max_tokens"
+
+    @property
+    def decode_tokens_per_s(self) -> float:
+        total = sum(self.decode_times_s)
+        return (len(self.decode_times_s) / total) if total > 0 else 0.0
+
+
+class PipelineClient:
+    """Drives generation across local stage0 + remote pipeline stages."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        plan: StagePlan,
+        stage0: StageExecutor,
+        transport: Transport,
+        registry: PlacementRegistry,
+        *,
+        use_module_routing: bool = False,
+        total_blocks: Optional[int] = None,
+        request_timeout: float = 60.0,
+        settle_seconds: float = SETTLE_SECONDS,
+        journal_max_entries: int = 256,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.plan = plan
+        self.stage0 = stage0
+        self.transport = transport
+        self.registry = registry
+        self.use_module_routing = use_module_routing
+        self.total_blocks = total_blocks or cfg.num_layers
+        self.request_timeout = request_timeout
+        self.settle_seconds = settle_seconds
+        self.journal_max_entries = journal_max_entries
+        self.seed = seed
+
+        # hop key -> session -> activation journal (src/rpc_transport.py:106)
+        self.journal: Dict[str, Dict[str, List[JournalEntry]]] = {}
+        # hop key -> peers that failed for that hop (src/rpc_transport.py:107-108)
+        self.failed_peers: Dict[str, set] = {}
+        self._route: Optional[List[Hop]] = None
+
+        # Metrics mirroring RpcTransport.last_prefill_stage_times /
+        # decode_stage_history (src/rpc_transport.py:98-103).
+        self.last_prefill_stage_times: Dict[str, float] = {}
+        self.decode_stage_history: List[Dict[str, float]] = []
+        self.recoveries: int = 0
+
+    # ------------------------------------------------------------------
+    # Routing
+    # ------------------------------------------------------------------
+
+    def _compute_route(self) -> List[Hop]:
+        if self.use_module_routing:
+            return self._compute_module_route()
+        hops: List[Hop] = []
+        for spec in self.plan.stages[1:]:
+            key = f"stage{spec.index}"
+            exclude = self.failed_peers.get(key, set())
+            peer = self.registry.discover_stage(spec.index, exclude=tuple(exclude))
+            if peer is None:
+                raise NoRouteError(f"no live server for {key}")
+            hops.append(Hop(key, peer, spec.start, spec.end, spec.is_last))
+        return hops
+
+    def _compute_module_route(self) -> List[Hop]:
+        """Greedy block-coverage routing (``src/rpc_transport.py:393-493``):
+        cover [stage0_end, total_blocks) hop by hop, each hop the candidate
+        with max end_block (tie-break throughput), loop-guarded, final hop
+        must serve the final stage."""
+        start = self.plan.stages[0].end
+        hops: List[Hop] = []
+        covered = start
+        while covered < self.total_blocks:
+            key = f"blocks{covered}"
+            exclude = self.failed_peers.get(key, set())
+            cands = self.registry.discover_block(covered, exclude=tuple(exclude))
+            # The hop must START at `covered` or earlier; its span past
+            # `covered` is what advances coverage.
+            cands = [c for c in cands if c.end_block > covered]
+            if not cands:
+                raise NoRouteError(f"no live server covers block {covered}")
+            best = max(cands, key=lambda c: (c.end_block, c.throughput))
+            if best.end_block <= covered:  # loop guard, rpc_transport.py:459-461
+                raise NoRouteError(f"route stuck at block {covered}")
+            is_final = best.end_block >= self.total_blocks
+            if is_final and not best.final_stage:
+                raise NoRouteError(
+                    f"last hop {best.peer_id} does not serve the final stage "
+                    "(src/rpc_transport.py:463-491 verification)"
+                )
+            hops.append(Hop(key, best.peer_id, covered, best.end_block, is_final))
+            covered = best.end_block
+        return hops
+
+    def route(self, refresh: bool = False) -> List[Hop]:
+        if self._route is None or refresh:
+            self._route = self._compute_route()
+        return self._route
+
+    # ------------------------------------------------------------------
+    # Journal + recovery
+    # ------------------------------------------------------------------
+
+    def _journal_append(self, key: str, session_id: str, entry: JournalEntry) -> None:
+        entries = self.journal.setdefault(key, {}).setdefault(session_id, [])
+        entries.append(entry)
+        if len(entries) > self.journal_max_entries:
+            # Coalesce the oldest adjacent pair whose merged chunk is still
+            # replayable (<= MAX_COALESCED_TOKENS — the executor's seq buckets
+            # cap what one replay request may carry). If every pair is at the
+            # cap the list grows past journal_max_entries, but is then bounded
+            # by max_length / MAX_COALESCED_TOKENS + recent singles.
+            for i in range(len(entries) - 1):
+                a, b = entries[i], entries[i + 1]
+                if a.seq_len + b.seq_len <= MAX_COALESCED_TOKENS:
+                    entries[i:i + 2] = [JournalEntry(
+                        hidden=np.concatenate([a.hidden, b.hidden], axis=1),
+                        seq_len=a.seq_len + b.seq_len,
+                        cur_len=a.cur_len,
+                    )]
+                    break
+
+    def _replay(self, hop: Hop, session_id: str, sampling: SamplingParams,
+                max_length: int) -> None:
+        """Rebuild a replacement peer's KV by replaying the journal
+        (``src/rpc_transport.py:670-712``): first chunk as prefill, the rest
+        as is_replay decode chunks with cumulative cur_len."""
+        entries = self.journal.get(hop.key, {}).get(session_id, [])
+        for i, e in enumerate(entries):
+            req = StageRequest(
+                session_id=session_id,
+                hidden=jnp.asarray(e.hidden),
+                seq_len=e.seq_len,
+                cur_len=e.cur_len,
+                is_prefill=(i == 0),
+                is_replay=True,
+                max_length=max_length,
+                sampling=sampling,
+            )
+            self.transport.call(hop.peer_id, req, timeout=self.request_timeout)
+
+    def _call_with_recovery(self, hop: Hop, req: StageRequest) -> StageResponse:
+        """3-attempt failover (``src/rpc_transport.py:587-668``)."""
+        last_exc: Optional[Exception] = None
+        for attempt in range(MAX_ATTEMPTS):
+            try:
+                return self.transport.call(hop.peer_id, req, timeout=self.request_timeout)
+            # Retryable taxonomy: connectivity faults + server-side session
+            # loss (StageExecutionError — failover+replay rebuilds the KV).
+            # Deliberately NOT the reference's broad RuntimeError/ValueError
+            # net (src/rpc_transport.py:618): a deterministic client-side bug
+            # would blacklist every healthy replica in turn.
+            except (PeerUnavailable, TimeoutError, ConnectionError,
+                    StageExecutionError) as exc:
+                last_exc = exc
+                failed = self.failed_peers.setdefault(hop.key, set())
+                failed.add(hop.peer_id)
+                logger.warning(
+                    "hop %s peer %s failed (attempt %d/%d): %s",
+                    hop.key, hop.peer_id, attempt + 1, MAX_ATTEMPTS, exc,
+                )
+                try:
+                    replacement = self._rediscover(hop)
+                except NoRouteError:
+                    continue  # maybe a peer re-registers before we run out
+                hop.peer_id = replacement
+                self.recoveries += 1
+                try:
+                    self._replay(hop, req.session_id, req.sampling, req.max_length)
+                except Exception as replay_exc:  # replacement died too
+                    last_exc = replay_exc
+                    failed.add(replacement)
+                    continue
+                if self.settle_seconds:
+                    time.sleep(self.settle_seconds)
+        raise RuntimeError(
+            f"hop {hop.key}: all {MAX_ATTEMPTS} attempts failed"
+        ) from last_exc
+
+    def _rediscover(self, hop: Hop) -> str:
+        peer = self._rediscover_excluding(
+            hop, tuple(self.failed_peers.get(hop.key, set()))
+        )
+        if peer is None:
+            # Every candidate is blacklisted. Failures are often transient
+            # (the reference never un-marks a failed peer and can wedge a
+            # long-lived client); give recently-failed peers another chance
+            # rather than hard-failing with live servers present.
+            self.failed_peers.get(hop.key, set()).clear()
+            peer = self._rediscover_excluding(hop, ())
+        if peer is None:
+            raise NoRouteError(f"no replacement for {hop.key}")
+        return peer
+
+    def _rediscover_excluding(self, hop: Hop, exclude: Tuple[str, ...]) -> Optional[str]:
+        if self.use_module_routing:
+            cands = [
+                c for c in self.registry.discover_block(hop.start_block, exclude=exclude)
+                # The replacement must cover the hop's exact span: downstream
+                # hops already hold KV for their own spans.
+                if c.start_block <= hop.start_block and c.end_block >= hop.end_block
+                and (not hop.expect_token or c.final_stage)
+            ]
+            if not cands:
+                return None
+            return max(cands, key=lambda c: (c.end_block, c.throughput)).peer_id
+        stage_index = int(hop.key.removeprefix("stage"))
+        return self.registry.discover_stage(stage_index, exclude=exclude)
+
+    # ------------------------------------------------------------------
+    # Pipeline walk
+    # ------------------------------------------------------------------
+
+    def _walk(self, hidden: jnp.ndarray, seq_len: int, cur_len: int,
+              session_id: str, *, is_prefill: bool, max_length: int,
+              sampling: SamplingParams, generated: Sequence[int],
+              step_seed: int, stage_times: Dict[str, float]) -> int:
+        """Send the activation through every remote hop; return the token."""
+        cur = hidden
+        token: Optional[int] = None
+        for hop in self.route():
+            req = StageRequest(
+                session_id=session_id,
+                hidden=cur,
+                seq_len=seq_len,
+                cur_len=cur_len,
+                is_prefill=is_prefill,
+                max_length=max_length,
+                sampling=sampling,
+                generated_tokens=clip_generated(generated),
+                step_seed=step_seed,
+            )
+            t0 = time.monotonic()
+            resp = self._call_with_recovery(hop, req)
+            stage_times[hop.key] = time.monotonic() - t0
+            # Journal AFTER success: replay then rebuilds exactly the applied
+            # history and the failed in-flight step is retried separately.
+            # (The reference appends BEFORE the call and replays the full
+            # journal including the in-flight entry — `rpc_transport.py:741`
+            # vs `:648-654` — re-applying the current step; we fix that.)
+            self._journal_append(
+                hop.key, session_id,
+                JournalEntry(np.asarray(cur), seq_len, cur_len),
+            )
+            if hop.expect_token:
+                if not resp.is_token:
+                    raise RuntimeError(f"final hop {hop.key} returned no token")
+                token = resp.token_id
+            else:
+                if resp.hidden is None:
+                    raise RuntimeError(f"hop {hop.key} returned no hidden states")
+                cur = resp.hidden
+        assert token is not None, "route had no final hop"
+        return token
+
+    # ------------------------------------------------------------------
+    # Generation (run_rank0, src/main.py:62-227)
+    # ------------------------------------------------------------------
+
+    def generate(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int = 64,
+        *,
+        sampling: Optional[SamplingParams] = None,
+        eos_token_id: Optional[int] = None,
+        session_id: Optional[str] = None,
+        max_length: Optional[int] = None,
+    ) -> GenerationResult:
+        sampling = sampling or SamplingParams()
+        session_id = session_id or f"sess-{time.monotonic_ns():x}"
+        prompt_len = len(prompt_ids)
+        max_length = max_length or (prompt_len + max_new_tokens)
+
+        ids = jnp.asarray(np.asarray(prompt_ids, np.int32)[None, :])
+        generated: List[int] = []
+        stopped_by = "max_tokens"
+
+        # ---- prefill (src/main.py:138-155) ----
+        t0 = time.monotonic()
+        s0_resp = self.stage0.forward(StageRequest(
+            session_id=session_id, hidden=ids, seq_len=prompt_len, cur_len=0,
+            is_prefill=True, max_length=max_length, sampling=sampling,
+        ))
+        times: Dict[str, float] = {}
+        token = self._walk(
+            s0_resp.hidden, prompt_len, 0, session_id,
+            is_prefill=True, max_length=max_length, sampling=sampling,
+            generated=generated, step_seed=self.seed, stage_times=times,
+        )
+        ttft = time.monotonic() - t0
+        self.last_prefill_stage_times = times
+        generated.append(token)
+
+        # ---- decode loop (src/main.py:164-211) ----
+        decode_times: List[float] = []
+        cur_len = prompt_len
+        for step in range(1, max_new_tokens):
+            if eos_token_id is not None and generated[-1] == eos_token_id:
+                stopped_by = "eos"
+                break
+            if len(generated) >= REPEAT_STOP and len(
+                set(generated[-REPEAT_STOP:])
+            ) == 1:
+                stopped_by = "repeat"
+                break
+            t0 = time.monotonic()
+            step_ids = jnp.asarray([[generated[-1]]], jnp.int32)
+            s0_resp = self.stage0.forward(StageRequest(
+                session_id=session_id, hidden=step_ids, seq_len=1,
+                cur_len=cur_len, is_prefill=False, max_length=max_length,
+                sampling=sampling,
+            ))
+            times = {}
+            token = self._walk(
+                s0_resp.hidden, 1, cur_len, session_id,
+                is_prefill=False, max_length=max_length, sampling=sampling,
+                generated=generated, step_seed=self.seed + step,
+                stage_times=times,
+            )
+            decode_times.append(time.monotonic() - t0)
+            self.decode_stage_history.append(times)
+            generated.append(token)
+            cur_len += 1
+
+        self._end_session(session_id)
+        return GenerationResult(
+            tokens=generated, ttft_s=ttft, decode_times_s=decode_times,
+            stopped_by=stopped_by,
+        )
+
+    def _end_session(self, session_id: str) -> None:
+        self.stage0.drop_session(session_id)
+        # Release the KV lease on every remote hop (best-effort) — without
+        # this, each generation permanently consumes remote arena budget.
+        if self._route:
+            for hop in self._route:
+                try:
+                    self.transport.end_session(hop.peer_id, session_id)
+                except Exception:  # a dead peer's lease dies with the peer
+                    pass
+        for sessions in self.journal.values():
+            sessions.pop(session_id, None)
+
+
+def make_server_record(peer_id: str, spec: StageSpec, *, throughput: float = 1.0,
+                       cache_tokens_left: Optional[int] = None) -> ServerRecord:
+    """Registry record for a fixed-split stage server (the triple DHT publish
+    of ``src/main.py:656-697`` collapsed into one record)."""
+    return ServerRecord(
+        peer_id=peer_id,
+        start_block=spec.start,
+        end_block=spec.end,
+        throughput=throughput,
+        final_stage=spec.is_last,
+        stage_index=spec.index,
+        cache_tokens_left=cache_tokens_left,
+    )

@@ -1,0 +1,216 @@
+"""Per-stage executor: the server-side compute path.
+
+TPU-native counterpart of the reference's ``StageConnectionHandler._run_forward``
+(``src/rpc_handler.py:149-325``): manage per-session KV, run the stage's layer
+span, and either return the next hidden states (intermediate stage) or sample a
+token (final stage — sampling happens ON the final server, with the sampling
+params and recent-token window taken from request metadata each step).
+
+Replay semantics preserved exactly (``src/rpc_handler.py:176-202``):
+  * prefill clears any existing session cache;
+  * decode with no cached session and ``is_replay=True`` is treated as a
+    prefill chunk (a replacement server rebuilding its KV from the journal);
+  * decode with no cached session and no replay flag is a hard error.
+
+XLA-specific design (no reference counterpart — it re-traces per request):
+  * the stage step is one jitted function per (cache_bucket, seq_bucket) pair;
+    real sequence lengths are padded up to a small set of buckets so an elastic
+    server sees a handful of compiles, then pure replay;
+  * right-padded prefill is safe end-to-end: padded queries only produce
+    garbage OUTPUT rows (discarded here before returning), and padded cache
+    rows sit at positions the causal mask hides until a later real token
+    overwrites them;
+  * KV buffers live in a fixed-budget `KVArena` (admission control before
+    dispatch — inside jit the cache write clamps rather than raises).
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.config import ModelConfig
+from ..models.partition import StageSpec, stage_forward
+from ..ops.sampling import RECENT_WINDOW, sample_token
+from .kv_cache import KVArena, KVHandle, round_to_bucket
+from .messages import StageRequest, StageResponse
+
+logger = logging.getLogger(__name__)
+
+SEQ_BUCKETS = (1, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+
+class StageExecutionError(RuntimeError):
+    """Server-side hard error (maps to the RuntimeError raised at
+    ``src/rpc_handler.py:198-202`` for decode-without-cache)."""
+
+
+class StageExecutor:
+    """One pipeline stage's compute engine (one 'server' in reference terms)."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        spec: StageSpec,
+        params: Dict[str, Any],
+        arena: Optional[KVArena] = None,
+        *,
+        max_cache_bytes: int = 1 << 30,
+        cache_dtype=jnp.float32,
+        peer_id: str = "local",
+        debug_activation_checks: bool = False,
+    ):
+        self.cfg = cfg
+        self.spec = spec
+        self.params = params
+        self.peer_id = peer_id
+        self.cache_dtype = jnp.dtype(cache_dtype)
+        self.arena = arena or KVArena(
+            num_layers=max(spec.num_layers, 1),
+            num_kv_heads=cfg.num_kv_heads,
+            head_dim=cfg.head_dim,
+            max_bytes=max_cache_bytes,
+            dtype=cache_dtype,
+        )
+        self.debug_activation_checks = debug_activation_checks
+        self.requests_served = 0
+
+        # One jitted step; jax.jit caches one executable per distinct
+        # (seq_bucket, cache_bucket) input-shape pair — the bucket padding
+        # below is what bounds how many shapes it ever sees.
+        @partial(jax.jit, donate_argnums=(2, 3))
+        def _step(params, x, k_cache, v_cache, cache_len):
+            return stage_forward(cfg, spec, params, x, k_cache, v_cache, cache_len)
+
+        self._step = _step
+
+    # ------------------------------------------------------------------
+    # Session / cache management (mirrors rpc_handler session semantics)
+    # ------------------------------------------------------------------
+
+    def _session_cache(self, req: StageRequest) -> KVHandle:
+        handle = self.arena.get(req.session_id)
+        if req.is_prefill:
+            # Prefill (re)starts the session: clear existing cache
+            # (src/rpc_handler.py:180-182).
+            if handle is not None:
+                self.arena.free(req.session_id)
+            handle = self.arena.allocate(req.session_id, req.max_length)
+        elif handle is None:
+            if req.is_replay:
+                # Replacement server rebuilding KV from the client's journal:
+                # treat the first replayed decode as a prefill
+                # (src/rpc_handler.py:187-196).
+                handle = self.arena.allocate(req.session_id, req.max_length)
+            else:
+                raise StageExecutionError(
+                    f"session {req.session_id}: decode step without KV cache "
+                    "and not a replay (src/rpc_handler.py:198-202 semantics)"
+                )
+        if not req.is_prefill and handle.cache_len != req.cur_len and not req.is_replay:
+            # The reference logs and proceeds with the server's own count
+            # (src/rpc_handler.py:206-225).
+            logger.warning(
+                "session %s: past-len mismatch client=%d server=%d; "
+                "trusting server", req.session_id, req.cur_len, handle.cache_len,
+            )
+        return handle
+
+    # ------------------------------------------------------------------
+    # Forward
+    # ------------------------------------------------------------------
+
+    def forward(self, req: StageRequest) -> StageResponse:
+        """Run one step of this stage for one session."""
+        handle = self._session_cache(req)
+        t_real = req.seq_len
+        handle.admit(t_real)
+
+        x = jnp.asarray(req.hidden)
+        # stage0 consumes int token ids [B, T]; later stages float hidden
+        # [B, T, D] (uniform signature, src/llama_partition.py:99-137).
+        want_ndim = 2 if self.spec.is_first else 3
+        if x.ndim != want_ndim:
+            raise StageExecutionError(
+                f"stage {self.spec.index} expects ndim={want_ndim}, got {x.shape}"
+            )
+        t = x.shape[1]
+        if t != t_real:
+            raise StageExecutionError(f"seq_len {t_real} != tensor T {t}")
+
+        tb = round_to_bucket(t_real, SEQ_BUCKETS)
+        if handle.cache_len + tb > handle.bucket_len:
+            # Padding would make the jitted dynamic_update_slice clamp its
+            # start index (writing garbage over the newest real rows). Fall
+            # back to the exact length — one extra compile at the tail of a
+            # session beats silent cache corruption.
+            tb = t_real
+        if tb != t_real:
+            pad = ((0, 0), (0, tb - t_real)) + (((0, 0),) if x.ndim == 3 else ())
+            x = jnp.pad(x, pad)
+
+        cache_len = jnp.asarray(handle.cache_len, jnp.int32)
+        out, handle.k, handle.v = self._step(
+            self.params, x, handle.k, handle.v, cache_len
+        )
+        handle.advance(t_real)
+        self.requests_served += 1
+
+        if self.spec.is_last:
+            token = self._sample(out, t_real, req)
+            return StageResponse(
+                session_id=req.session_id, token_id=int(token),
+                cache_len=handle.cache_len,
+            )
+        out = out[:, :t_real]
+        if self.debug_activation_checks:
+            # Activation-explosion guard (src/rpc_handler.py:316-319). Opt-in:
+            # the float() forces a host sync per hop per token, which would
+            # serialize the decode hot path if always on.
+            max_abs = float(jnp.max(jnp.abs(out)))
+            if max_abs > 100.0:
+                logger.warning(
+                    "session %s stage %d: activation explosion |x|=%.1f",
+                    req.session_id, self.spec.index, max_abs,
+                )
+        return StageResponse(
+            session_id=req.session_id, hidden=out, cache_len=handle.cache_len
+        )
+
+    def _sample(self, logits: jnp.ndarray, t_real: int, req: StageRequest) -> int:
+        """Final-stage sampling from the last REAL token's logits, using the
+        metadata-shipped params + recent window (``src/rpc_handler.py:268-307``)."""
+        last = logits[0, t_real - 1]  # [V] fp32 (lm_head upcasts)
+        recent = np.zeros((RECENT_WINDOW,), np.int32)
+        n = min(len(req.generated_tokens), RECENT_WINDOW)
+        if n:
+            recent[:n] = np.asarray(req.generated_tokens[-n:], np.int32)
+        sp = req.sampling
+        rng = jax.random.PRNGKey(req.step_seed)
+        token = sample_token(
+            rng,
+            last,
+            jnp.asarray(recent),
+            jnp.asarray(n, jnp.int32),
+            jnp.asarray(sp.temperature, jnp.float32),
+            jnp.asarray(sp.top_p, jnp.float32),
+            jnp.asarray(sp.top_k, jnp.int32),
+            jnp.asarray(sp.repetition_penalty, jnp.float32),
+        )
+        return int(token)
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    def drop_session(self, session_id: str) -> None:
+        self.arena.free(session_id)
+
+    def session_len(self, session_id: str) -> Optional[int]:
+        h = self.arena.get(session_id)
+        return None if h is None else h.cache_len

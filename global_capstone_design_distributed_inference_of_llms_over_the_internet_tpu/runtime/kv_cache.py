@@ -1,0 +1,266 @@
+"""Session KV-cache arena: fixed HBM budget, admission control, backpressure.
+
+TPU-native counterpart of the vendored Petals ``MemoryCache``
+(``petals/server/memory_cache.py:26-221``): a fixed-budget attention-cache
+allocator with alloc-with-timeout, bytes-left accounting, and handle
+lifecycle. The reference crosses a process boundary (handlers allocate,
+runtime materializes, via mp.Values/pipes); here both sides live in one
+process per stage host, so the cross-process machinery collapses to a
+``threading.Condition`` — same semantics, no pipes.
+
+Two further reference behaviors preserved:
+  * admission control: a session declares ``max_length`` up front and every
+    step is checked against it BEFORE dispatch (the ``inference_max_length``
+    guard of ``petals/server/handler.py:163-166`` and
+    ``block_functions.py:193-197``) — this is what makes the jitted
+    ``dynamic_update_slice`` cache writes safe (they clamp, never raise).
+  * backpressure: when the arena is full, allocation WAITS (up to a timeout)
+    for another session to free memory rather than failing immediately
+    (``memory_cache.py:148-193``).
+
+TPU-specific design: cache buffers are static-shape ``[L, 1, bucket_len, Hkv,
+Dh]`` device arrays. ``max_length`` is rounded up to a small set of
+power-of-two buckets so every (layer-span, bucket) pair compiles exactly one
+prefill and one decode executable — an elastic server that re-spans (LB
+rebalance) reuses executables instead of triggering recompilation storms
+(SURVEY.md §7.3 hard part 2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class AllocationFailed(RuntimeError):
+    """Raised when the arena cannot satisfy an allocation within the timeout
+    (mirrors ``petals/server/memory_cache.py:224-225``)."""
+
+
+class AdmissionDenied(RuntimeError):
+    """Raised when a step would exceed the session's declared max_length."""
+
+
+def round_to_bucket(n: int, buckets: Tuple[int, ...]) -> int:
+    """Smallest bucket >= n. Raises if n exceeds the largest bucket."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise AllocationFailed(
+        f"requested max_length={n} exceeds largest cache bucket {buckets[-1]}"
+    )
+
+
+DEFAULT_BUCKETS = (128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768)
+
+
+@dataclasses.dataclass
+class KVHandle:
+    """One session's cache lease on one stage.
+
+    Owns the device buffers; `cache_len` is the number of valid tokens
+    (the reference's ``prefix_length``, ``block_functions.py:237``).
+    """
+
+    session_id: str
+    max_length: int          # admission limit declared by the client
+    bucket_len: int          # physical buffer length (>= max_length)
+    nbytes: int
+    k: jnp.ndarray           # [L, 1, bucket_len, Hkv, Dh]
+    v: jnp.ndarray
+    cache_len: int = 0
+    last_used: float = dataclasses.field(default_factory=time.monotonic)
+    freed: bool = False
+
+    def admit(self, new_tokens: int) -> None:
+        """Admission check before dispatching a step (never inside jit)."""
+        if self.cache_len + new_tokens > self.max_length:
+            raise AdmissionDenied(
+                f"session {self.session_id}: {self.cache_len}+{new_tokens} "
+                f"tokens > max_length {self.max_length}"
+            )
+
+    def advance(self, new_tokens: int) -> None:
+        self.cache_len += new_tokens
+        self.last_used = time.monotonic()
+
+    def rewind(self, position: int) -> None:
+        """Rewind the valid prefix (the ``start_from_position`` session rewind
+        of ``petals/server/handler.py:163-168``). Stale rows beyond `position`
+        are dead weight — later writes overwrite them."""
+        if not 0 <= position <= self.cache_len:
+            raise ValueError(f"rewind to {position} outside [0,{self.cache_len}]")
+        self.cache_len = position
+
+
+class KVArena:
+    """Fixed-budget KV allocator for one pipeline stage.
+
+    Parameters give the per-token cost; the budget is expressed in bytes like
+    the reference's ``max_size_bytes`` (``memory_cache.py:32``).
+    """
+
+    def __init__(
+        self,
+        num_layers: int,
+        num_kv_heads: int,
+        head_dim: int,
+        max_bytes: int,
+        dtype=jnp.bfloat16,
+        buckets: Tuple[int, ...] = DEFAULT_BUCKETS,
+        alloc_timeout: float = 10.0,
+        device: Optional[jax.Device] = None,
+    ):
+        self.num_layers = num_layers
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.max_bytes = max_bytes
+        self.dtype = jnp.dtype(dtype)
+        self.buckets = tuple(sorted(buckets))
+        self.alloc_timeout = alloc_timeout
+        self.device = device
+
+        self._lock = threading.Condition()
+        self._used_bytes = 0
+        # Bytes already promised to waiting allocations, so concurrent waiters
+        # don't both claim the same freed space (the enqueued-size accounting
+        # of ``memory_cache.py:118-146``).
+        self._enqueued_bytes = 0
+        self._handles: Dict[str, KVHandle] = {}
+        self._pending: set = set()  # session ids mid-allocation (dup guard)
+
+    # -- accounting ---------------------------------------------------------
+
+    def bytes_for(self, bucket_len: int) -> int:
+        per_token = 2 * self.num_layers * self.num_kv_heads * self.head_dim
+        return per_token * bucket_len * self.dtype.itemsize
+
+    @property
+    def used_bytes(self) -> int:
+        return self._used_bytes
+
+    @property
+    def bytes_left(self) -> int:
+        return self.max_bytes - self._used_bytes - self._enqueued_bytes
+
+    def tokens_left(self) -> int:
+        """Advertised capacity (the DHT's ``cache_tokens_left``,
+        ``petals/server/server.py:721``)."""
+        per_token = 2 * self.num_layers * self.num_kv_heads * self.head_dim
+        return max(0, self.bytes_left) // (per_token * self.dtype.itemsize)
+
+    # -- allocation ---------------------------------------------------------
+
+    def allocate(
+        self, session_id: str, max_length: int, timeout: Optional[float] = None
+    ) -> KVHandle:
+        """Lease cache space for a session; blocks (≤ timeout) when full."""
+        timeout = self.alloc_timeout if timeout is None else timeout
+        bucket_len = round_to_bucket(max_length, self.buckets)
+        nbytes = self.bytes_for(bucket_len)
+        if nbytes > self.max_bytes:
+            raise AllocationFailed(
+                f"allocation of {nbytes} bytes can never fit arena of "
+                f"{self.max_bytes} bytes"
+            )
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            if session_id in self._handles or session_id in self._pending:
+                raise AllocationFailed(f"session {session_id} already allocated")
+            self._pending.add(session_id)
+            self._enqueued_bytes += nbytes
+            try:
+                while self.max_bytes - self._used_bytes < nbytes:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._lock.wait(remaining):
+                        raise AllocationFailed(
+                            f"arena full: {self._used_bytes}/{self.max_bytes} "
+                            f"bytes used, need {nbytes}, timed out after "
+                            f"{timeout:.1f}s"
+                        )
+                self._used_bytes += nbytes
+            except BaseException:
+                self._pending.discard(session_id)
+                raise
+            finally:
+                self._enqueued_bytes -= nbytes
+
+        try:
+            shape = (self.num_layers, 1, bucket_len, self.num_kv_heads, self.head_dim)
+            k = jnp.zeros(shape, self.dtype)
+            v = jnp.zeros(shape, self.dtype)
+            if self.device is not None:
+                k = jax.device_put(k, self.device)
+                v = jax.device_put(v, self.device)
+        except BaseException:
+            # Roll back the budget reservation (e.g. device OOM while
+            # materializing) — otherwise the bytes leak from the arena forever.
+            with self._lock:
+                self._used_bytes -= nbytes
+                self._pending.discard(session_id)
+                self._lock.notify_all()
+            raise
+        handle = KVHandle(
+            session_id=session_id,
+            max_length=max_length,
+            bucket_len=bucket_len,
+            nbytes=nbytes,
+            k=k,
+            v=v,
+        )
+        with self._lock:
+            self._pending.discard(session_id)
+            self._handles[session_id] = handle
+        return handle
+
+    def get(self, session_id: str) -> Optional[KVHandle]:
+        with self._lock:
+            return self._handles.get(session_id)
+
+    def free(self, session_id: str) -> None:
+        with self._lock:
+            handle = self._handles.pop(session_id, None)
+            if handle is None or handle.freed:
+                return
+            handle.freed = True
+            handle.k = None  # type: ignore[assignment]  # drop device buffers
+            handle.v = None  # type: ignore[assignment]
+            self._used_bytes -= handle.nbytes
+            self._lock.notify_all()
+
+    @contextmanager
+    def session(self, session_id: str, max_length: int, timeout: Optional[float] = None):
+        """``async with allocate_cache(...)`` of ``memory_cache.py:71-107``,
+        synchronous flavor."""
+        handle = self.allocate(session_id, max_length, timeout)
+        try:
+            yield handle
+        finally:
+            self.free(session_id)
+
+    def evict_idle(self, older_than: float) -> int:
+        """Free sessions idle longer than `older_than` seconds. Returns count.
+
+        The reference leaks sessions until process exit (`rpc_handler.py:70`
+        has no eviction); elastic servers need this to survive abandoned
+        clients.
+        """
+        now = time.monotonic()
+        with self._lock:
+            stale = [
+                sid for sid, h in self._handles.items()
+                if now - h.last_used > older_than
+            ]
+        for sid in stale:
+            self.free(sid)
+        return len(stale)
+
+    def active_sessions(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(self._handles)

@@ -1,0 +1,62 @@
+"""Stage request/response schema — the in-process mirror of the wire protocol.
+
+Semantically mirrors the reference's ``ExpertRequest``/``ExpertResponse``
+protobufs + msgpack metadata sidecar (SURVEY.md Appendix B;
+``src/rpc_transport.py:725-734,788-798`` and ``src/rpc_handler.py:301-325``):
+
+  request:  {session_id, seq_len, cur_len, is_prefill, is_replay, max_length,
+             temperature, top_p, top_k, repetition_penalty,
+             generated_tokens[-50:]} + one hidden tensor [B, T, D]
+  response (intermediate): hidden tensor [B, T, D]
+  response (final): token_id
+
+The reference ships sampling params and the recent-token window in metadata on
+EVERY step so the final server can sample statelessly — we keep that property:
+it is exactly what makes failover to a replacement final stage work without
+migrating sampler state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..ops.sampling import SamplingParams
+
+
+@dataclasses.dataclass
+class StageRequest:
+    """One hop's worth of work for a pipeline stage."""
+
+    session_id: str
+    hidden: jnp.ndarray            # [B, T, D] activation entering the span
+    seq_len: int                   # number of REAL (unpadded) tokens in hidden
+    cur_len: int                   # tokens already in this session before this step
+    is_prefill: bool
+    max_length: int                # session KV admission limit
+    is_replay: bool = False        # replaying journal into a replacement peer
+    sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    generated_tokens: Tuple[int, ...] = ()   # last <=50, for repetition penalty
+    step_seed: int = 0             # deterministic per-step sampling seed
+
+
+@dataclasses.dataclass
+class StageResponse:
+    """What a stage returns: hidden states (intermediate) or a token (final)."""
+
+    session_id: str
+    hidden: Optional[jnp.ndarray] = None   # [B, T, D]
+    token_id: Optional[int] = None
+    cache_len: int = 0                     # server-side KV length after the step
+
+    @property
+    def is_token(self) -> bool:
+        return self.token_id is not None
+
+
+def clip_generated(tokens: Sequence[int], window: int = 50) -> Tuple[int, ...]:
+    """The reference sends only the last 50 generated tokens
+    (``src/rpc_transport.py:788-798``)."""
+    return tuple(int(t) for t in tokens[-window:])

@@ -1,0 +1,154 @@
+"""Transport abstraction between pipeline client and stage servers.
+
+The reference's data plane is libp2p unary/streaming protobuf RPC
+(``src/rpc_transport.py:519-585`` client side, ``src/rpc_handler.py:405-464``
+server side). On TPU the hot path should be ICI collectives, not RPC — but the
+*capability* contract (sessioned request/response between a client and named
+stage peers, with peers that can fail) still needs a transport seam. Two
+implementations:
+
+  * `LocalTransport` — all stage executors in one process. This is the fake
+    in-process backend the reference never had (SURVEY.md §4: its only
+    "integration test" spawned real subprocesses and a human compared logs).
+    First-class fault injection: kill/stall/flake a peer programmatically,
+    the deterministic version of ``scripts/kill_stage.py``.
+  * the fused ICI pipeline (`parallel.pipeline`) bypasses the transport
+    entirely for co-located meshes — stages exchange activations via
+    collective-permute inside one XLA program; the transport remains the
+    control-plane/elastic path (multi-host DCN, elastic membership).
+
+Failure taxonomy mirrors the reference's catch tuple
+(``src/rpc_transport.py:618``): transports raise `PeerUnavailable`
+(ConnectionError) or `TimeoutError`, both retryable by the client's recovery
+wrapper.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from .executor import StageExecutor
+from .messages import StageRequest, StageResponse
+
+
+class PeerUnavailable(ConnectionError):
+    """The peer is dead/unreachable (client must fail over)."""
+
+
+class Transport(abc.ABC):
+    """Client-side view: submit a request to a named peer."""
+
+    @abc.abstractmethod
+    def call(self, peer_id: str, request: StageRequest,
+             timeout: Optional[float] = None) -> StageResponse:
+        ...
+
+    @abc.abstractmethod
+    def alive(self, peer_id: str) -> bool:
+        ...
+
+    def end_session(self, peer_id: str, session_id: str) -> None:
+        """Best-effort: release the session's KV lease on a peer. The reference
+        leaks server sessions forever (``src/rpc_handler.py:70`` has no
+        eviction); servers should also run `KVArena.evict_idle` as backstop."""
+
+
+class LocalTransport(Transport):
+    """In-process transport over a dict of stage executors.
+
+    Fault injection (deterministic counterpart of ``scripts/kill_stage.py`` +
+    the manual protocol in ``scripts/test_fault_tolerance.py:5-10``):
+      * `kill(peer)` — subsequent calls raise PeerUnavailable;
+      * `stall(peer, seconds)` — calls sleep then raise TimeoutError if the
+        stall exceeds the caller's timeout (models a hung host);
+      * `fail_next(peer, n)` — the next n calls fail, then recover (models a
+        transient network partition).
+    """
+
+    def __init__(self):
+        self._peers: Dict[str, StageExecutor] = {}
+        self._dead: Dict[str, bool] = {}
+        self._stall_s: Dict[str, float] = {}
+        self._fail_next: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.calls: int = 0
+        # Optional per-call tap for tracing/tests: (peer_id, request) -> None
+        self.on_call: Optional[Callable[[str, StageRequest], None]] = None
+
+    # -- membership ---------------------------------------------------------
+
+    def add_peer(self, peer_id: str, executor: StageExecutor) -> None:
+        with self._lock:
+            self._peers[peer_id] = executor
+            self._dead[peer_id] = False
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._lock:
+            self._peers.pop(peer_id, None)
+            self._dead.pop(peer_id, None)
+
+    def executor(self, peer_id: str) -> StageExecutor:
+        return self._peers[peer_id]
+
+    def peers(self):
+        with self._lock:
+            return tuple(self._peers)
+
+    # -- fault injection ----------------------------------------------------
+
+    def kill(self, peer_id: str) -> None:
+        with self._lock:
+            self._dead[peer_id] = True
+
+    def revive(self, peer_id: str) -> None:
+        with self._lock:
+            self._dead[peer_id] = False
+
+    def stall(self, peer_id: str, seconds: float) -> None:
+        with self._lock:
+            self._stall_s[peer_id] = seconds
+
+    def fail_next(self, peer_id: str, n: int = 1) -> None:
+        with self._lock:
+            self._fail_next[peer_id] = n
+
+    # -- Transport ----------------------------------------------------------
+
+    def alive(self, peer_id: str) -> bool:
+        with self._lock:
+            return peer_id in self._peers and not self._dead.get(peer_id, True)
+
+    def end_session(self, peer_id: str, session_id: str) -> None:
+        with self._lock:
+            executor = self._peers.get(peer_id)
+            dead = self._dead.get(peer_id, True)
+        if executor is not None and not dead:
+            executor.drop_session(session_id)
+
+    def call(self, peer_id: str, request: StageRequest,
+             timeout: Optional[float] = None) -> StageResponse:
+        with self._lock:
+            self.calls += 1
+            executor = self._peers.get(peer_id)
+            dead = self._dead.get(peer_id, True)
+            stall = self._stall_s.get(peer_id, 0.0)
+            flake = self._fail_next.get(peer_id, 0)
+            if flake > 0:
+                self._fail_next[peer_id] = flake - 1
+        if self.on_call is not None:
+            self.on_call(peer_id, request)
+        if executor is None or dead:
+            raise PeerUnavailable(f"peer {peer_id} is not reachable")
+        if flake > 0:
+            raise PeerUnavailable(f"peer {peer_id} transient failure (injected)")
+        if stall > 0.0:
+            if timeout is not None and stall > timeout:
+                time.sleep(timeout)
+                raise TimeoutError(
+                    f"peer {peer_id} timed out after {timeout:.1f}s (stalled)"
+                )
+            time.sleep(stall)
+        return executor.forward(request)

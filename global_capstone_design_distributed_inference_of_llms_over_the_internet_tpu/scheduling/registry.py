@@ -1,0 +1,170 @@
+"""Placement registry: the service-discovery layer (DHT-schema mirror).
+
+The reference's control plane is a Kademlia DHT (``src/dht_utils.py``) storing
+three kinds of records:
+
+  * ``mini_petals:stage{N}``  -> {subkey=peer_id: (value, expiration)} — one
+    record per pipeline stage, many servers per stage (``src/main.py:517-527``);
+  * ``petals:module:<model>:block_i`` -> same, one record per transformer
+    block, used by load balancing + module routing (``src/dht_utils.py:82-133``);
+  * ``petals:server:<model>:<peer_id>`` -> server info blob
+    (``src/dht_utils.py:34-79``).
+
+On a TPU pod the ICI topology is static, so the hot path needs no discovery at
+all (SURVEY.md §2.3); this registry exists for the *elastic multi-host* story:
+servers register/heartbeat with a TTL, dead servers expire, clients discover
+and load balancing reads coverage. Single-process implementation with the same
+record schema; a multi-host deployment points every process at one registry
+service (see runtime.dcn) — the schema is the contract, the backend is
+swappable.
+
+TTL/liveness semantics preserved: records expire TTL seconds after their last
+refresh (reference default 45s, refreshed every TTL/3 — ``src/main.py:520-537``);
+discovery prefers the newest records and picks randomly among the 5 freshest
+(``src/rpc_transport.py:337-344``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+DEFAULT_TTL = 45.0          # src/main.py:524
+DISCOVERY_POOL = 5          # random among 5 newest, src/rpc_transport.py:337-344
+
+
+class ServerState:
+    """Lifecycle states (``src/load_balancing.py:17-21``)."""
+
+    JOINING = "joining"
+    ONLINE = "online"
+    OFFLINE = "offline"
+
+
+@dataclasses.dataclass
+class ServerRecord:
+    """One server's registration (the DHT value at ``src/dht_utils.py:57-67``)."""
+
+    peer_id: str
+    start_block: int
+    end_block: int
+    throughput: float = 1.0
+    state: str = ServerState.ONLINE
+    final_stage: bool = False
+    stage_index: Optional[int] = None      # fixed-split mode stage number
+    cache_tokens_left: Optional[int] = None  # petals/server/server.py:721
+    timestamp: float = dataclasses.field(default_factory=time.monotonic)
+    expires_at: float = 0.0
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (now or time.monotonic()) >= self.expires_at
+
+
+class PlacementRegistry:
+    """In-process registry with TTL liveness. Thread-safe."""
+
+    def __init__(self, ttl: float = DEFAULT_TTL, rng: Optional[random.Random] = None):
+        self.ttl = ttl
+        self._lock = threading.Lock()
+        self._servers: Dict[str, ServerRecord] = {}
+        self._rng = rng or random.Random()
+
+    # -- registration / heartbeat ------------------------------------------
+
+    def register(self, record: ServerRecord, ttl: Optional[float] = None) -> None:
+        """Register or refresh a server (covers both ``register_server_on_dht``
+        and ``register_blocks_on_dht`` — block coverage is derived from the
+        span, there is no separate per-block write to keep consistent)."""
+        now = time.monotonic()
+        record.timestamp = now
+        record.expires_at = now + (ttl if ttl is not None else self.ttl)
+        with self._lock:
+            self._servers[record.peer_id] = record
+
+    def heartbeat(self, peer_id: str, throughput: Optional[float] = None,
+                  cache_tokens_left: Optional[int] = None) -> bool:
+        """Refresh TTL (+ optionally throughput, mirroring
+        ``update_server_throughput_on_dht``). Returns False if unknown."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self._servers.get(peer_id)
+            if rec is None:
+                return False
+            rec.timestamp = now
+            rec.expires_at = now + self.ttl
+            if throughput is not None:
+                rec.throughput = throughput
+            if cache_tokens_left is not None:
+                rec.cache_tokens_left = cache_tokens_left
+            return True
+
+    def unregister(self, peer_id: str) -> None:
+        with self._lock:
+            self._servers.pop(peer_id, None)
+
+    def set_state(self, peer_id: str, state: str) -> None:
+        with self._lock:
+            rec = self._servers.get(peer_id)
+            if rec is not None:
+                rec.state = state
+
+    # -- queries ------------------------------------------------------------
+
+    def _live(self, now: Optional[float] = None) -> List[ServerRecord]:
+        now = now or time.monotonic()
+        with self._lock:
+            # Purge expired entries on read (the DHT does this implicitly).
+            dead = [p for p, r in self._servers.items() if r.expired(now)]
+            for p in dead:
+                del self._servers[p]
+            return list(self._servers.values())
+
+    def live_servers(self) -> List[ServerRecord]:
+        return self._live()
+
+    def get(self, peer_id: str) -> Optional[ServerRecord]:
+        with self._lock:
+            rec = self._servers.get(peer_id)
+            if rec is not None and rec.expired():
+                del self._servers[peer_id]
+                return None
+            return rec
+
+    def discover_stage(self, stage_index: int,
+                       exclude: Sequence[str] = ()) -> Optional[str]:
+        """Pick a server for a fixed-split stage: random among the 5 newest
+        live candidates, excluding known-failed peers
+        (``src/rpc_transport.py:270-353``)."""
+        cands = [
+            r for r in self._live()
+            if r.stage_index == stage_index and r.peer_id not in exclude
+            and r.state == ServerState.ONLINE
+        ]
+        return self._pick_newest(cands)
+
+    def discover_block(self, block: int, exclude: Sequence[str] = ()) -> List[ServerRecord]:
+        """All live ONLINE servers covering `block` (module-routing mode)."""
+        return [
+            r for r in self._live()
+            if r.start_block <= block < r.end_block and r.peer_id not in exclude
+            and r.state == ServerState.ONLINE
+        ]
+
+    def _pick_newest(self, cands: List[ServerRecord]) -> Optional[str]:
+        if not cands:
+            return None
+        cands.sort(key=lambda r: r.timestamp, reverse=True)
+        pool = cands[:DISCOVERY_POOL]
+        return self._rng.choice(pool).peer_id
+
+    def coverage(self, total_blocks: int) -> List[List[ServerRecord]]:
+        """Per-block server lists — the shape of ``get_remote_module_infos``
+        (``src/dht_utils.py:147-242``); feeds load balancing."""
+        live = self._live()
+        return [
+            [r for r in live if r.start_block <= b < r.end_block]
+            for b in range(total_blocks)
+        ]

@@ -1,0 +1,127 @@
+"""KV arena: budget accounting, backpressure, admission control, eviction.
+
+Covers the semantics of the reference's MemoryCache
+(``petals/server/memory_cache.py``) that the arena must preserve.
+"""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.kv_cache import (
+    AdmissionDenied,
+    AllocationFailed,
+    KVArena,
+    round_to_bucket,
+)
+
+
+BYTES_PER_TOKEN = 2 * 2 * 2 * 4 * 4  # k+v * layers * kv_heads * head_dim * fp32
+
+
+def make_arena(max_bytes=None, **kw):
+    defaults = dict(
+        num_layers=2, num_kv_heads=2, head_dim=4,
+        dtype=jnp.float32, buckets=(8, 16, 32), alloc_timeout=0.2,
+    )
+    defaults.update(kw)
+    if max_bytes is None:
+        max_bytes = BYTES_PER_TOKEN * 32  # exactly one 32-token bucket
+    return KVArena(max_bytes=max_bytes, **defaults)
+
+
+def test_bucket_rounding():
+    assert round_to_bucket(1, (8, 16)) == 8
+    assert round_to_bucket(8, (8, 16)) == 8
+    assert round_to_bucket(9, (8, 16)) == 16
+    with pytest.raises(AllocationFailed):
+        round_to_bucket(17, (8, 16))
+
+
+def test_allocate_shapes_and_accounting():
+    arena = make_arena()
+    h = arena.allocate("s1", max_length=10)
+    assert h.bucket_len == 16
+    assert h.k.shape == (2, 1, 16, 2, 4)
+    assert arena.used_bytes == BYTES_PER_TOKEN * 16
+    assert arena.tokens_left() == 16  # 32-token budget minus 16 used
+    arena.free("s1")
+    assert arena.used_bytes == 0
+
+
+def test_admission_control():
+    arena = make_arena()
+    h = arena.allocate("s1", max_length=10)
+    h.admit(10)
+    h.advance(10)
+    with pytest.raises(AdmissionDenied):
+        h.admit(1)  # 10+1 > max_length 10, even though bucket holds 16
+    h.rewind(4)
+    h.admit(6)  # rewind frees logical space
+
+
+def test_oversized_allocation_rejected():
+    arena = make_arena()
+    with pytest.raises(AllocationFailed):
+        arena.allocate("big", max_length=100)  # beyond largest bucket
+
+
+def test_full_arena_times_out():
+    arena = make_arena()  # budget = one 32-bucket
+    arena.allocate("s1", max_length=32)
+    t0 = time.monotonic()
+    with pytest.raises(AllocationFailed):
+        arena.allocate("s2", max_length=8, timeout=0.1)
+    assert time.monotonic() - t0 >= 0.1  # actually waited (backpressure)
+
+
+def test_backpressure_wakes_waiter():
+    arena = make_arena()
+    arena.allocate("s1", max_length=32)
+    results = {}
+
+    def waiter():
+        results["h"] = arena.allocate("s2", max_length=8, timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    arena.free("s1")  # frees space -> waiter should succeed
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert results["h"].bucket_len == 8
+
+
+def test_double_allocate_same_session_rejected():
+    arena = make_arena()
+    arena.allocate("s1", max_length=8)
+    with pytest.raises(AllocationFailed):
+        arena.allocate("s1", max_length=8)
+
+
+def test_session_context_manager_frees():
+    arena = make_arena()
+    with arena.session("s1", max_length=8) as h:
+        assert arena.used_bytes == h.nbytes
+    assert arena.used_bytes == 0
+
+
+def test_evict_idle():
+    arena = make_arena()
+    h = arena.allocate("s1", max_length=8)
+    h.last_used = time.monotonic() - 100
+    arena.allocate("s2", max_length=8)
+    assert arena.evict_idle(older_than=50) == 1
+    assert arena.active_sessions() == ("s2",)
+
+
+def test_rewind_bounds():
+    arena = make_arena()
+    h = arena.allocate("s1", max_length=8)
+    h.advance(4)
+    with pytest.raises(ValueError):
+        h.rewind(5)
+    with pytest.raises(ValueError):
+        h.rewind(-1)
