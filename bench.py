@@ -1,0 +1,95 @@
+"""Benchmark: steady-state decode throughput on the real chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: gpt2 (124M, the reference's primary config — README.md:46-53) in
+bfloat16, batch 8, 64-token prefill, 64 fused greedy decode steps where the
+whole (forward + argmax + KV update) step is one donated jitted program — the
+XLA counterpart of the reference's CUDA-graph decode path
+(petals/llama/cuda_graphs.py).
+
+The reference publishes no numbers (BASELINE.md), so vs_baseline compares
+against the previous round's own recording (BENCH_r*.json) when present,
+else 1.0.
+"""
+
+import glob
+import json
+import os
+import re
+import time
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+    full_forward,
+    get_config,
+    init_kv_cache,
+    init_params,
+)
+
+BATCH = 8
+PREFILL = 64
+DECODE_STEPS = 64
+MAX_LEN = PREFILL + DECODE_STEPS
+
+
+def main():
+    cfg = get_config("gpt2")
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.bfloat16)
+    kc, vc = init_kv_cache(cfg, cfg.num_layers, BATCH, MAX_LEN, dtype=jnp.bfloat16)
+
+    @partial(jax.jit, donate_argnums=(2, 3))
+    def prefill(params, ids, kc, vc):
+        logits, kc, vc = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
+        return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32), kc, vc
+
+    @partial(jax.jit, donate_argnums=(2, 3))
+    def decode(params, tok, kc, vc, cache_len):
+        logits, kc, vc = full_forward(cfg, params, tok[:, None], kc, vc, cache_len)
+        return jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32), kc, vc
+
+    ids = jax.random.randint(jax.random.PRNGKey(1), (BATCH, PREFILL), 0,
+                             cfg.vocab_size, jnp.int32)
+    tok, kc, vc = prefill(params, ids, kc, vc)
+
+    # warmup decode (compile)
+    tok_w, kc, vc = decode(params, tok, kc, vc, jnp.int32(PREFILL))
+    tok_w.block_until_ready()
+
+    t0 = time.perf_counter()
+    cache_len = PREFILL + 1
+    tok = tok_w
+    for i in range(DECODE_STEPS):
+        tok, kc, vc = decode(params, tok, kc, vc, jnp.int32(cache_len))
+        cache_len += 1
+    tok.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_s = BATCH * DECODE_STEPS / dt
+
+    prev = None
+    for path in sorted(glob.glob("BENCH_r*.json"),
+                       key=lambda p: int(re.search(r"r(\d+)", p).group(1))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            if rec.get("unit") == "tokens/s":
+                prev = rec.get("value")
+        except Exception:
+            pass
+    vs = tokens_per_s / prev if prev else 1.0
+
+    print(json.dumps({
+        "metric": "gpt2_bf16_b8_decode_throughput",
+        "value": round(tokens_per_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

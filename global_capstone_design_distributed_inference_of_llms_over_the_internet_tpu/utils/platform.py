@@ -1,0 +1,37 @@
+"""Host-platform plumbing shared by tests, the driver dry-run, and tools.
+
+The container's sitecustomize registers the ``axon`` PJRT plugin (the real-TPU
+tunnel) and bakes ``jax_platforms="axon"`` into jax.config, so the usual
+``JAX_PLATFORMS=cpu`` env var alone does not switch to CPU. This helper is the
+single place that knows the workaround; tests/conftest.py and
+``__graft_entry__.dryrun_multichip`` both use it.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n: int, hard: bool = False) -> None:
+    """Point JAX at an n-device virtual CPU host platform.
+
+    Must run before the JAX backend initializes. ``hard=True`` (tests)
+    performs the override unconditionally; ``hard=False`` (driver dry-run)
+    is best-effort and leaves an already-initialized backend alone.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+        from jax._src import xla_bridge
+
+        if hard or not xla_bridge._backends:
+            xla_bridge._backend_factories.pop("axon", None)
+            jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        if hard:
+            raise

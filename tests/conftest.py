@@ -5,25 +5,14 @@ exercised on host CPU devices — the reference had no equivalent in-process
 test rig at all (SURVEY.md §4: verification was operational/manual).
 """
 
-import os
+# FORCE cpu: the container env pins JAX_PLATFORMS=axon (the real-TPU tunnel)
+# and a wedged tunnel would hang every test at backend init. The workaround
+# details live in one place, utils.platform.
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.utils.platform import (
+    force_cpu_devices,
+)
 
-# FORCE cpu (not setdefault): the container env pins JAX_PLATFORMS=axon (the
-# real-TPU tunnel) and a wedged tunnel would hang every test at backend init.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-# The axon PJRT plugin is registered by sitecustomize before conftest runs
-# (which also bakes jax_platforms="axon" into jax.config); drop its (lazy)
-# factory and re-point the config so no test can touch the TPU tunnel.
-import jax  # noqa: E402
-from jax._src import xla_bridge  # noqa: E402
-
-xla_bridge._backend_factories.pop("axon", None)
-jax.config.update("jax_platforms", "cpu")
+force_cpu_devices(8, hard=True)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
