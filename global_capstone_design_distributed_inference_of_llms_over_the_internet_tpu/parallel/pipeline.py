@@ -188,7 +188,7 @@ class IciPipeline:
         body = _pipeline_body(cfg, num_stages, num_micro)
         spec_kv = P("stage")
 
-        @partial(jax.jit, donate_argnums=(3, 4), static_argnums=())
+        @partial(jax.jit, donate_argnums=(3, 4))
         def step(embed_p, head_p, layers_p, k_all, v_all, ids, cache_len):
             m, b, t = ids.shape
             positions = cache_len + jnp.arange(t, dtype=jnp.int32)[None, :]

@@ -219,6 +219,8 @@ class PipelineClient:
                 is_replay=True,
                 max_length=max_length,
                 sampling=sampling,
+                start_block=hop.start_block,
+                end_block=hop.end_block,
             )
             self.transport.call(hop.peer_id, req, timeout=self.request_timeout)
 
@@ -312,6 +314,8 @@ class PipelineClient:
                 sampling=sampling,
                 generated_tokens=clip_generated(generated),
                 step_seed=step_seed,
+                start_block=hop.start_block,
+                end_block=hop.end_block,
             )
             t0 = time.monotonic()
             resp = self._call_with_recovery(hop, req)

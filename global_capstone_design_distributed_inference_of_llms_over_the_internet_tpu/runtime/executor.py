@@ -35,7 +35,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.config import ModelConfig
-from ..models.partition import StageSpec, stage_forward
+from ..models.partition import (
+    ROLE_FULL,
+    ROLE_LAST,
+    ROLE_SEGMENT,
+    ROLE_STAGE0,
+    StageSpec,
+    stage_forward,
+)
 from ..ops.sampling import RECENT_WINDOW, sample_token
 from .kv_cache import KVArena, KVHandle, round_to_bucket
 from .messages import StageRequest, StageResponse
@@ -80,33 +87,92 @@ class StageExecutor:
         self.debug_activation_checks = debug_activation_checks
         self.requests_served = 0
 
-        # One jitted step; jax.jit caches one executable per distinct
-        # (seq_bucket, cache_bucket) input-shape pair — the bucket padding
-        # below is what bounds how many shapes it ever sees.
-        @partial(jax.jit, donate_argnums=(2, 3))
-        def _step(params, x, k_cache, v_cache, cache_len):
-            return stage_forward(cfg, spec, params, x, k_cache, v_cache, cache_len)
+        # Sub-span execution units, keyed by relative layer range (a, b). A
+        # request may cover only part of the loaded span (the uid-chain of
+        # petals/server/handler.py:522-530): elastic placement yields
+        # OVERLAPPING server spans, and running the full span on a hidden
+        # state that already passed some of its blocks silently corrupts the
+        # output. The route assigns each hop an exact range; we execute
+        # exactly that. Each entry holds (sub_spec, sub_params, jitted step);
+        # jax.jit then caches one executable per (seq_bucket, cache_bucket)
+        # input-shape pair — the bucket padding below bounds how many shapes
+        # it ever sees.
+        self._subspans: Dict[tuple, tuple] = {}
+        self._get_subspan(0, spec.num_layers)
 
-        self._step = _step
+    def _get_subspan(self, a: int, b: int):
+        key = (a, b)
+        entry = self._subspans.get(key)
+        if entry is not None:
+            return entry
+        spec = self.spec
+        if a == 0 and b == spec.num_layers:
+            sub_spec, sub_params = spec, self.params
+        else:
+            first = spec.is_first and a == 0
+            last = spec.is_last and b == spec.num_layers
+            role = (ROLE_FULL if first and last else ROLE_STAGE0 if first
+                    else ROLE_LAST if last else ROLE_SEGMENT)
+            sub_spec = StageSpec(spec.index, role, spec.start + a, spec.start + b)
+            sub_params = {}
+            if "layers" in self.params:
+                sub_params["layers"] = jax.tree.map(
+                    lambda x: x[a:b], self.params["layers"]
+                )
+            if first and "embed" in self.params:
+                sub_params["embed"] = self.params["embed"]
+            if last:
+                for k in ("final_norm", "lm_head"):
+                    if k in self.params:
+                        sub_params[k] = self.params[k]
+                if self.cfg.tie_word_embeddings and "embed" in self.params:
+                    sub_params.setdefault("embed", {})
+                    sub_params["embed"] = {**sub_params["embed"],
+                                           "wte": self.params["embed"]["wte"]}
+
+        cfg = self.cfg
+
+        @partial(jax.jit, donate_argnums=(2, 3))
+        def step(params, x, k_cache, v_cache, cache_len):
+            return stage_forward(cfg, sub_spec, params, x, k_cache, v_cache,
+                                 cache_len)
+
+        entry = (sub_spec, sub_params, step)
+        self._subspans[key] = entry
+        return entry
+
+    def _resolve_range(self, req: StageRequest) -> tuple:
+        """Absolute request block range -> relative (a, b) within the span."""
+        a = 0 if req.start_block is None else req.start_block - self.spec.start
+        b = (self.spec.num_layers if req.end_block is None
+             else req.end_block - self.spec.start)
+        if not (0 <= a < b <= max(self.spec.num_layers, 1)):
+            raise StageExecutionError(
+                f"requested blocks [{req.start_block},{req.end_block}) outside "
+                f"served span [{self.spec.start},{self.spec.end})"
+            )
+        return a, b
 
     # ------------------------------------------------------------------
     # Session / cache management (mirrors rpc_handler session semantics)
     # ------------------------------------------------------------------
 
-    def _session_cache(self, req: StageRequest) -> KVHandle:
+    def _session_cache(self, req: StageRequest, num_layers: int) -> KVHandle:
         handle = self.arena.get(req.session_id)
         if req.is_prefill:
             # Prefill (re)starts the session: clear existing cache
             # (src/rpc_handler.py:180-182).
             if handle is not None:
                 self.arena.free(req.session_id)
-            handle = self.arena.allocate(req.session_id, req.max_length)
+            handle = self.arena.allocate(req.session_id, req.max_length,
+                                         num_layers=num_layers)
         elif handle is None:
             if req.is_replay:
                 # Replacement server rebuilding KV from the client's journal:
                 # treat the first replayed decode as a prefill
                 # (src/rpc_handler.py:187-196).
-                handle = self.arena.allocate(req.session_id, req.max_length)
+                handle = self.arena.allocate(req.session_id, req.max_length,
+                                             num_layers=num_layers)
             else:
                 raise StageExecutionError(
                     f"session {req.session_id}: decode step without KV cache "
@@ -127,14 +193,22 @@ class StageExecutor:
 
     def forward(self, req: StageRequest) -> StageResponse:
         """Run one step of this stage for one session."""
-        handle = self._session_cache(req)
+        a, b = self._resolve_range(req)
+        sub_spec, sub_params, step = self._get_subspan(a, b)
+        handle = self._session_cache(req, num_layers=max(b - a, 1))
+        if handle.k is not None and handle.k.shape[0] != max(b - a, 1):
+            raise StageExecutionError(
+                f"session {req.session_id} was allocated for "
+                f"{handle.k.shape[0]} layers but the request covers {b - a} "
+                "(a route must use a stable block range per hop)"
+            )
         t_real = req.seq_len
         handle.admit(t_real)
 
         x = jnp.asarray(req.hidden)
         # stage0 consumes int token ids [B, T]; later stages float hidden
         # [B, T, D] (uniform signature, src/llama_partition.py:99-137).
-        want_ndim = 2 if self.spec.is_first else 3
+        want_ndim = 2 if sub_spec.is_first else 3
         if x.ndim != want_ndim:
             raise StageExecutionError(
                 f"stage {self.spec.index} expects ndim={want_ndim}, got {x.shape}"
@@ -155,13 +229,13 @@ class StageExecutor:
             x = jnp.pad(x, pad)
 
         cache_len = jnp.asarray(handle.cache_len, jnp.int32)
-        out, handle.k, handle.v = self._step(
-            self.params, x, handle.k, handle.v, cache_len
+        out, handle.k, handle.v = step(
+            sub_params, x, handle.k, handle.v, cache_len
         )
         handle.advance(t_real)
         self.requests_served += 1
 
-        if self.spec.is_last:
+        if sub_spec.is_last:
             token = self._sample(out, t_real, req)
             return StageResponse(
                 session_id=req.session_id, token_id=int(token),

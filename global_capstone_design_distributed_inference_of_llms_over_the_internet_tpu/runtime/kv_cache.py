@@ -137,8 +137,9 @@ class KVArena:
 
     # -- accounting ---------------------------------------------------------
 
-    def bytes_for(self, bucket_len: int) -> int:
-        per_token = 2 * self.num_layers * self.num_kv_heads * self.head_dim
+    def bytes_for(self, bucket_len: int, num_layers: Optional[int] = None) -> int:
+        layers = self.num_layers if num_layers is None else num_layers
+        per_token = 2 * layers * self.num_kv_heads * self.head_dim
         return per_token * bucket_len * self.dtype.itemsize
 
     @property
@@ -158,12 +159,18 @@ class KVArena:
     # -- allocation ---------------------------------------------------------
 
     def allocate(
-        self, session_id: str, max_length: int, timeout: Optional[float] = None
+        self, session_id: str, max_length: int, timeout: Optional[float] = None,
+        num_layers: Optional[int] = None,
     ) -> KVHandle:
-        """Lease cache space for a session; blocks (≤ timeout) when full."""
+        """Lease cache space for a session; blocks (≤ timeout) when full.
+
+        `num_layers` sizes the buffers for a sub-span execution (the
+        uid-chain case — a request covering only part of the server's loaded
+        span); defaults to the arena's full layer count."""
         timeout = self.alloc_timeout if timeout is None else timeout
+        layers = self.num_layers if num_layers is None else num_layers
         bucket_len = round_to_bucket(max_length, self.buckets)
-        nbytes = self.bytes_for(bucket_len)
+        nbytes = self.bytes_for(bucket_len, layers)
         if nbytes > self.max_bytes:
             raise AllocationFailed(
                 f"allocation of {nbytes} bytes can never fit arena of "
@@ -192,7 +199,7 @@ class KVArena:
                 self._enqueued_bytes -= nbytes
 
         try:
-            shape = (self.num_layers, 1, bucket_len, self.num_kv_heads, self.head_dim)
+            shape = (layers, 1, bucket_len, self.num_kv_heads, self.head_dim)
             k = jnp.zeros(shape, self.dtype)
             v = jnp.zeros(shape, self.dtype)
             if self.device is not None:

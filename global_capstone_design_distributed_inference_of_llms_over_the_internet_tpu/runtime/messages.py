@@ -40,6 +40,13 @@ class StageRequest:
     sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
     generated_tokens: Tuple[int, ...] = ()   # last <=50, for repetition penalty
     step_seed: int = 0             # deterministic per-step sampling seed
+    # Block sub-range to execute, absolute indices. None = the server's whole
+    # span. This is the uid-chain of the Petals protocol
+    # (``petals/server/handler.py:522-530``): elastic placement produces
+    # OVERLAPPING spans, and a hop must run exactly the blocks the route
+    # assigned it, not everything it has loaded.
+    start_block: Optional[int] = None
+    end_block: Optional[int] = None
 
 
 @dataclasses.dataclass

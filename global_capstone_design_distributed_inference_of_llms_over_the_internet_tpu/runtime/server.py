@@ -1,0 +1,329 @@
+"""Stage server lifecycle: fixed-split and elastic (load-balancing) modes.
+
+TPU-native counterpart of the reference's server orchestration layer:
+
+  * fixed mode (``src/main.py:243-278,426-555``): serve a statically assigned
+    span; register on the placement registry with a TTL and refresh the
+    heartbeat every TTL/3;
+  * elastic mode (``src/main.py:281-423,558-772`` + vendored
+    ``petals/server/server.py:328-384``): scan coverage, run
+    `choose_best_blocks` (rule 1) to pick a span, build the stage executor for
+    it, probe throughput, serve, and periodically — after a RANDOMIZED delay
+    in [0, 2·mean_period), so simultaneous checks don't dogpile
+    (``src/main.py:710-744``, ``petals/server/server.py:403-411``) — run
+    `should_choose_other_blocks` (rule 2) and re-span when the swarm would
+    improve past balance_quality.
+
+Threading model: all state transitions are exposed as synchronous tick
+methods (`heartbeat_once`, `maybe_rebalance`) so tests drive them
+deterministically — the in-process analogue of the reference's
+sleep-loop threads, which are also provided (`start`/`stop`) for real
+deployments.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from ..models.config import ModelConfig
+from ..models.partition import ROLE_LAST, ROLE_SEGMENT, StageSpec
+from ..scheduling import load_balancing as lb
+from ..scheduling.registry import (
+    PlacementRegistry,
+    ServerRecord,
+    ServerState,
+)
+from ..scheduling.throughput import get_server_throughput
+from .executor import StageExecutor
+from .transport import LocalTransport
+
+logger = logging.getLogger(__name__)
+
+Params = Dict[str, Any]
+ParamsProvider = Callable[[StageSpec], Params]
+
+
+class ElasticStageServer:
+    """One elastic server: owns an executor for its current span and the
+    registry records advertising it.
+
+    `params_provider(spec)` returns the parameter shard for a span — backed by
+    `slice_stage_params` over in-memory params, or by a per-span checkpoint
+    loader (the per-block fetch style of ``petals/server/from_pretrained.py``).
+    """
+
+    def __init__(
+        self,
+        peer_id: str,
+        cfg: ModelConfig,
+        params_provider: ParamsProvider,
+        registry: PlacementRegistry,
+        transport: LocalTransport,
+        *,
+        num_blocks: int,
+        total_blocks: Optional[int] = None,
+        min_block: int = 0,
+        balance_quality: float = 0.75,
+        mean_balance_check_period: float = 120.0,
+        objective: str = lb.WEAKEST,
+        bandwidth_mbps: Optional[float] = None,
+        probe_throughput: bool = False,
+        rng: Optional[random.Random] = None,
+    ):
+        self.peer_id = peer_id
+        self.cfg = cfg
+        self.params_provider = params_provider
+        self.registry = registry
+        self.transport = transport
+        self.num_blocks = num_blocks
+        self.total_blocks = total_blocks or cfg.num_layers
+        self.min_block = min_block
+        self.balance_quality = balance_quality
+        self.mean_balance_check_period = mean_balance_check_period
+        self.objective = objective
+        self.bandwidth_mbps = bandwidth_mbps
+        self.probe_throughput = probe_throughput
+        self._rng = rng or random.Random()
+        self._np_rng = np.random.default_rng(self._rng.randrange(2**31))
+
+        self.executor: Optional[StageExecutor] = None
+        self.spec: Optional[StageSpec] = None
+        self.throughput: float = 1.0
+        self.rebalances: int = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # Span lifecycle
+    # ------------------------------------------------------------------
+
+    def _spec_for(self, start: int, end: int) -> StageSpec:
+        role = ROLE_LAST if end >= self.total_blocks else ROLE_SEGMENT
+        return StageSpec(index=start, role=role, start=start, end=end)
+
+    def choose_span(self) -> StageSpec:
+        """Rule 1 over the current live swarm (excluding self)."""
+        records = [r for r in self.registry.live_servers()
+                   if r.peer_id != self.peer_id]
+        blocks = lb.choose_best_blocks(
+            self.num_blocks, records, total_blocks=self.total_blocks,
+            min_block=self.min_block, objective=self.objective,
+        )
+        return self._spec_for(blocks[0], blocks[-1] + 1)
+
+    def load_span(self, spec: StageSpec) -> None:
+        """(Re)build the executor for a span and advertise it.
+
+        Announce-then-serve ordering mirrors the reference: JOINING is
+        published first so concurrent joiners see the claim
+        (``petals/server/server.py:468-481``), flipped ONLINE once the
+        executor is ready."""
+        self.registry.register(ServerRecord(
+            peer_id=self.peer_id, start_block=spec.start, end_block=spec.end,
+            throughput=self.throughput, state=ServerState.JOINING,
+            final_stage=spec.is_last,
+        ))
+        params = self.params_provider(spec)
+        self.executor = StageExecutor(self.cfg, spec, params, peer_id=self.peer_id)
+        self.spec = spec
+        self.transport.add_peer(self.peer_id, self.executor)
+        if self.probe_throughput:
+            self.throughput = self._probe()
+        self.registry.register(self._record())
+        logger.info("%s serving blocks [%d, %d) throughput=%.2f",
+                    self.peer_id, spec.start, spec.end, self.throughput)
+
+    def _record(self) -> ServerRecord:
+        assert self.spec is not None
+        return ServerRecord(
+            peer_id=self.peer_id,
+            start_block=self.spec.start,
+            end_block=self.spec.end,
+            throughput=self.throughput,
+            state=ServerState.ONLINE,
+            final_stage=self.spec.is_last,
+            cache_tokens_left=(
+                self.executor.arena.tokens_left() if self.executor else None
+            ),
+        )
+
+    def _probe(self) -> float:
+        """Self-benchmark: timed batch-1 seq-1 forward through the span
+        (``src/main.py:394-403`` -> ``throughput_measurement.py:193``)."""
+        import jax.numpy as jnp
+
+        from .messages import StageRequest
+
+        assert self.executor is not None and self.spec is not None
+        d = self.cfg.hidden_size
+        probe_session = f"__probe__{self.peer_id}"
+        n = [0]
+
+        def step():
+            n[0] += 1
+            sid = f"{probe_session}-{n[0]}"
+            self.executor.forward(StageRequest(
+                session_id=sid,
+                hidden=jnp.zeros((1, 1, d), jnp.float32),
+                seq_len=1, cur_len=0, is_prefill=True, max_length=8,
+            ))
+            self.executor.drop_session(sid)
+
+        return get_server_throughput(
+            step, self.cfg.hidden_size, bandwidth_mbps=self.bandwidth_mbps,
+            num_blocks=self.spec.num_layers,
+        )
+
+    # ------------------------------------------------------------------
+    # Ticks (deterministic test surface)
+    # ------------------------------------------------------------------
+
+    def start_serving(self) -> None:
+        self.load_span(self.choose_span())
+
+    def heartbeat_once(self) -> None:
+        """TTL refresh + throughput/cache gossip (``src/main.py:529-537``).
+
+        If the record already expired (missed beats — GC pause, suspend), it
+        is RE-CREATED: the reference's heartbeat is a full DHT store each
+        time, so a server self-heals back into the swarm; a refresh-only
+        heartbeat would leave it serving but invisible forever."""
+        if self.spec is None:
+            return
+        if not self.registry.heartbeat(
+            self.peer_id, throughput=self.throughput,
+            cache_tokens_left=(
+                self.executor.arena.tokens_left() if self.executor else None
+            ),
+        ):
+            self.registry.register(self._record())
+
+    def maybe_rebalance(self) -> bool:
+        """Rule 2; on True, tear down and re-span (``src/main.py:405-416``).
+        Returns whether a re-span happened."""
+        if self.spec is None:
+            return False
+        records = self.registry.live_servers()
+        if not lb.should_choose_other_blocks(
+            self.peer_id, records, total_blocks=self.total_blocks,
+            balance_quality=self.balance_quality, min_block=self.min_block,
+            objective=self.objective, rng=self._np_rng,
+        ):
+            return False
+        logger.info("%s rebalancing away from [%d, %d)",
+                    self.peer_id, self.spec.start, self.spec.end)
+        old_spec = self.spec
+        self.shutdown(deregister=True)
+        try:
+            self.start_serving()
+        except Exception:
+            # Failed mid-re-span (e.g. the params provider's checkpoint fetch):
+            # restore the old span rather than stranding a torn-down server.
+            logger.exception("%s: re-span failed, restoring [%d, %d)",
+                             self.peer_id, old_spec.start, old_spec.end)
+            self.load_span(old_spec)
+            return False
+        self.rebalances += 1
+        return True
+
+    def next_check_delay(self) -> float:
+        """Randomized rebalance-check delay in [0, 2·mean_period)
+        (``src/main.py:710-744``)."""
+        return self._rng.random() * 2.0 * self.mean_balance_check_period
+
+    def shutdown(self, deregister: bool = True) -> None:
+        self.transport.remove_peer(self.peer_id)
+        if deregister:
+            self.registry.unregister(self.peer_id)
+        else:
+            self.registry.set_state(self.peer_id, ServerState.OFFLINE)
+        self.executor = None
+        self.spec = None
+
+    # ------------------------------------------------------------------
+    # Background loop (deployment surface)
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        """Serve + heartbeat + randomized rebalance checks in a daemon thread."""
+        self.start_serving()
+        self._stop.clear()
+
+        def loop():
+            next_check = self.next_check_delay()
+            elapsed = 0.0
+            beat = self.registry.ttl / 3.0
+            while not self._stop.wait(beat):
+                # One transient failure must not kill the daemon (the
+                # reference wraps its heartbeat body too, src/main.py:529-535).
+                try:
+                    self.heartbeat_once()
+                    elapsed += beat
+                    if elapsed >= next_check:
+                        self.maybe_rebalance()
+                        elapsed, next_check = 0.0, self.next_check_delay()
+                except Exception:
+                    logger.exception("%s: serve-loop tick failed; continuing",
+                                     self.peer_id)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.shutdown(deregister=True)
+
+
+class FixedStageServer:
+    """Fixed-split server: a statically assigned span + heartbeat
+    (``src/main.py:243-278``). Thin compared to the elastic server — the span
+    never changes; stage_index routing is used by fixed-mode clients."""
+
+    def __init__(
+        self,
+        peer_id: str,
+        cfg: ModelConfig,
+        spec: StageSpec,
+        params: Params,
+        registry: PlacementRegistry,
+        transport: LocalTransport,
+        *,
+        throughput: float = 1.0,
+    ):
+        self.peer_id = peer_id
+        self.spec = spec
+        self.registry = registry
+        self.transport = transport
+        self.throughput = throughput
+        self.executor = StageExecutor(cfg, spec, params, peer_id=peer_id)
+
+    def _record(self) -> ServerRecord:
+        return ServerRecord(
+            peer_id=self.peer_id, start_block=self.spec.start,
+            end_block=self.spec.end, throughput=self.throughput,
+            state=ServerState.ONLINE, final_stage=self.spec.is_last,
+            stage_index=self.spec.index,
+        )
+
+    def start_serving(self) -> None:
+        self.transport.add_peer(self.peer_id, self.executor)
+        self.registry.register(self._record())
+
+    def heartbeat_once(self) -> None:
+        if not self.registry.heartbeat(
+            self.peer_id, throughput=self.throughput,
+            cache_tokens_left=self.executor.arena.tokens_left(),
+        ):
+            self.registry.register(self._record())  # self-heal after expiry
+
+    def shutdown(self) -> None:
+        self.transport.remove_peer(self.peer_id)
+        self.registry.unregister(self.peer_id)

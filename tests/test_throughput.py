@@ -1,0 +1,89 @@
+"""Throughput self-measurement semantics (reference parity)."""
+
+import time
+
+import pytest
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.scheduling.throughput import (
+    DEFAULT_BANDWIDTH_MBPS,
+    FALLBACK_RPS,
+    RELAY_PENALTY,
+    estimate_network_rps,
+    get_server_throughput,
+    hidden_request_bytes,
+    measure_compute_rps,
+)
+
+
+def test_measure_compute_rps_basic():
+    calls = []
+
+    def step():
+        calls.append(1)
+        time.sleep(0.001)
+
+    rps = measure_compute_rps(step)
+    assert len(calls) == 12  # 2 warmup + 10 timed
+    assert 0 < rps < 1000
+
+
+def test_measure_survives_partial_failures():
+    n = [0]
+
+    def flaky():
+        n[0] += 1
+        if n[0] % 2:
+            raise RuntimeError("boom")
+        time.sleep(0.001)
+
+    assert measure_compute_rps(flaky) is not None
+
+
+def test_measure_none_when_all_fail():
+    def dead():
+        raise RuntimeError("down")
+
+    assert measure_compute_rps(dead) is None
+
+
+def test_network_rps_defaults():
+    # 100 Mbps, 2-byte * 768 hidden = 1536 bytes -> 100e6/8/1536
+    rps = estimate_network_rps(None, hidden_request_bytes(768))
+    assert rps == pytest.approx(DEFAULT_BANDWIDTH_MBPS * 1e6 / 8 / 1536)
+
+
+def test_combination_min_and_relay():
+    fast_step_rps = get_server_throughput(
+        lambda: None, hidden_size=768, bandwidth_mbps=0.01)
+    # network-bound: 0.01 Mbps over 1536 bytes ~ 0.8 rps
+    assert fast_step_rps == pytest.approx(0.01 * 1e6 / 8 / 1536)
+    relayed = get_server_throughput(
+        lambda: None, hidden_size=768, bandwidth_mbps=0.01, use_relay=True)
+    assert relayed == pytest.approx(fast_step_rps * (1 - RELAY_PENALTY))
+
+
+def test_fallback_chain_no_step():
+    # no compute probe -> network-only estimate, never the hard fallback
+    rps = get_server_throughput(None, hidden_size=768, bandwidth_mbps=None)
+    assert rps == pytest.approx(DEFAULT_BANDWIDTH_MBPS * 1e6 / 8 / 1536)
+    assert FALLBACK_RPS > 0  # the constant itself stays sane
+
+
+def test_blocks_correction():
+    def instant():
+        pass
+
+    one = get_server_throughput(instant, hidden_size=8, bandwidth_mbps=1e9)
+    many = get_server_throughput(instant, hidden_size=8, bandwidth_mbps=1e9,
+                                 num_blocks=7)
+    # compute term scaled by 2/(n+1) = 1/4
+    assert many == pytest.approx(one / 4, rel=0.5)
+
+
+def test_cache_roundtrip(tmp_path):
+    path = str(tmp_path / "tput.json")
+    v1 = get_server_throughput(lambda: time.sleep(0.001), hidden_size=768,
+                               cache_path=path, cache_key="m|d|bf16")
+    v2 = get_server_throughput(lambda: time.sleep(0.5), hidden_size=768,
+                               cache_path=path, cache_key="m|d|bf16")
+    assert v2 == v1  # second call served from cache, not re-measured
