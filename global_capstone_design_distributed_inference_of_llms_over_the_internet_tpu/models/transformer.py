@@ -180,6 +180,14 @@ def _moe_mlp(cfg: ModelConfig, p: Params, x: jnp.ndarray, tp_axis: Optional[str]
         topi,
     ].set(weights)
 
+    # Expert parallelism: when the expert weights are sharded over tp_axis
+    # (router stays replicated so the top-k is global), each device computes
+    # its local experts' contribution and the closing psum combines them.
+    e_local = p["wg"].shape[0]
+    if tp_axis is not None and e_local != cfg.num_experts:
+        offset = jax.lax.axis_index(tp_axis) * e_local
+        dense_w = jax.lax.dynamic_slice_in_dim(dense_w, offset, e_local, axis=2)
+
     gate = jax.nn.silu(jnp.einsum("btd,edi->btei", x, p["wg"]))
     up = jnp.einsum("btd,edi->btei", x, p["wu"])
     per_expert = jnp.einsum("btei,eid->bted", gate * up, p["wd"])

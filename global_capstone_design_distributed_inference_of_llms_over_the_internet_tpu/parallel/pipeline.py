@@ -49,15 +49,23 @@ from ..models.transformer import embed_tokens, lm_head, stack_forward
 Params = Dict[str, Any]
 
 
-def make_pipeline_mesh(num_stages: int, devices=None) -> Mesh:
-    devices = devices if devices is not None else jax.devices()[:num_stages]
-    if len(devices) < num_stages:
+def make_pipeline_mesh(num_stages: int, devices=None, tp: int = 1) -> Mesh:
+    """1-D ("stage",) pipeline mesh, or 2-D ("stage", "tp") when tp > 1 —
+    tensor parallelism nests INSIDE each pipeline stage's device group, so
+    the per-stage psums ride the innermost (fastest) mesh axis."""
+    need = num_stages * tp
+    devices = devices if devices is not None else jax.devices()[:need]
+    if len(devices) < need:
         raise ValueError(
-            f"need {num_stages} devices for the fused pipeline, have {len(devices)}"
+            f"need {need} devices for the fused pipeline "
+            f"({num_stages} stages x {tp} tp), have {len(devices)}"
         )
     import numpy as np
 
-    return Mesh(np.asarray(devices[:num_stages]), ("stage",))
+    arr = np.asarray(devices[:need])
+    if tp == 1:
+        return Mesh(arr, ("stage",))
+    return Mesh(arr.reshape(num_stages, tp), ("stage", "tp"))
 
 
 def stack_pipeline_params(params: Params, num_stages: int) -> Params:
@@ -74,6 +82,13 @@ def stack_pipeline_params(params: Params, num_stages: int) -> Params:
     )
 
 
+def _kv_spec(tp: int) -> P:
+    """PartitionSpec for the pipeline KV cache laid out by `init_pipeline_kv`:
+    [S, L/S, M, B, max_len, Hkv, Dh] — "stage" on axis 0, "tp" on the Hkv
+    axis when TP is on. Single source of truth for build() and init_kv()."""
+    return P("stage", None, None, None, None, "tp") if tp > 1 else P("stage")
+
+
 def init_pipeline_kv(
     cfg: ModelConfig, num_stages: int, num_micro: int, micro_batch: int,
     max_len: int, dtype=jnp.float32,
@@ -84,10 +99,30 @@ def init_pipeline_kv(
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
-def _pipeline_body(cfg: ModelConfig, num_stages: int, num_micro: int):
+def _pipeline_layer_specs(cfg: ModelConfig, layers_stacked: Params,
+                          tp: int) -> Params:
+    """PartitionSpecs for the [S, L/S, ...] stacked layer tree: axis 0 on
+    "stage", plus the TP table (axes shifted +1 for the stage dim) when
+    tp > 1."""
+    if tp == 1:
+        return jax.tree.map(lambda _: P("stage"), layers_stacked)
+    from .tensor_parallel import layer_partition_specs
+
+    spec_for = layer_partition_specs(cfg, "tp")
+
+    def f(path, _leaf):
+        sub = spec_for(path)  # spec for the [L, ...] leaf (axis 0 = layers)
+        parts = ["stage"] + list(sub)
+        return P(*parts)
+
+    return jax.tree_util.tree_map_with_path(f, layers_stacked)
+
+
+def _pipeline_body(cfg: ModelConfig, num_stages: int, num_micro: int,
+                   tp_axis: Optional[str] = None):
     """Builds the shard-mapped tick loop. Local views per stage device:
-    layers [1, L/S, ...]; stream [M, B, T, D] (replicated); kv
-    [1, L/S, M, B, max_len, Hkv, Dh]; positions [B, T] (replicated)."""
+    layers [1, L/S, ...(tp-sharded dims)]; stream [M, B, T, D] (replicated);
+    kv [1, L/S, M, B, max_len, Hkv(/tp), Dh]; positions [B, T] (replicated)."""
 
     def body(layers, stream, k_all, v_all, positions, cache_len):
         layers = jax.tree.map(lambda x: x[0], layers)   # [L/S, ...]
@@ -109,9 +144,10 @@ def _pipeline_body(cfg: ModelConfig, num_stages: int, num_micro: int):
             )
             kc = jax.lax.dynamic_index_in_dim(k_all, mbc, 1, keepdims=False)
             vc = jax.lax.dynamic_index_in_dim(v_all, mbc, 1, keepdims=False)
-            # kc/vc: [L/S, B, max_len, Hkv, Dh]
+            # kc/vc: [L/S, B, max_len, Hkv(/tp), Dh]
             out, nk, nv = stack_forward(
-                cfg, layers, x_in, positions, kc, vc, cache_len
+                cfg, layers, x_in, positions, kc, vc, cache_len,
+                tp_axis=tp_axis,
             )
             # Mask bubble ticks: garbage KV writes must not land.
             nk = jnp.where(valid, nk, kc)
@@ -159,9 +195,10 @@ class IciPipeline:
     mesh: Mesh
     num_stages: int
     num_micro: int
+    tp: int
     embed: Params               # replicated
     head: Params                # replicated: final_norm (+ lm_head / tied wte)
-    layers_stacked: Params      # [S, L/S, ...] sharded on stage
+    layers_stacked: Params      # [S, L/S, ...] sharded on stage (+ tp dims)
     _step: Any
 
     @staticmethod
@@ -171,12 +208,26 @@ class IciPipeline:
         num_stages: int,
         num_micro: int = 1,
         mesh: Optional[Mesh] = None,
+        tp: int = 1,
     ) -> "IciPipeline":
-        mesh = mesh or make_pipeline_mesh(num_stages)
+        if tp > 1:
+            from .tensor_parallel import validate_tp
+
+            validate_tp(cfg, tp)
+        mesh = mesh or make_pipeline_mesh(num_stages, tp=tp)
+        if mesh.shape.get("stage") != num_stages or mesh.shape.get("tp", 1) != tp:
+            raise ValueError(
+                f"mesh axes {dict(mesh.shape)} do not match num_stages="
+                f"{num_stages}, tp={tp} — pass the same tp to both "
+                "make_pipeline_mesh and build"
+            )
         layers = stack_pipeline_params(params, num_stages)
-        stage_sharding = NamedSharding(mesh, P("stage"))
+        layer_specs = _pipeline_layer_specs(cfg, layers, tp)
+        layers = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            layers, layer_specs,
+        )
         repl = NamedSharding(mesh, P())
-        layers = jax.device_put(layers, stage_sharding)
         embed = jax.device_put(params["embed"], repl)
         head = {"final_norm": params["final_norm"]}
         if cfg.tie_word_embeddings:
@@ -185,8 +236,9 @@ class IciPipeline:
             head["lm_head"] = params["lm_head"]
         head = jax.device_put(head, repl)
 
-        body = _pipeline_body(cfg, num_stages, num_micro)
-        spec_kv = P("stage")
+        tp_axis = "tp" if tp > 1 else None
+        body = _pipeline_body(cfg, num_stages, num_micro, tp_axis=tp_axis)
+        spec_kv = _kv_spec(tp)
 
         @partial(jax.jit, donate_argnums=(3, 4))
         def step(embed_p, head_p, layers_p, k_all, v_all, ids, cache_len):
@@ -199,7 +251,7 @@ class IciPipeline:
             sharded = shard_map(
                 body,
                 mesh=mesh,
-                in_specs=(P("stage"), P(), spec_kv, spec_kv, P(), P()),
+                in_specs=(layer_specs, P(), spec_kv, spec_kv, P(), P()),
                 out_specs=(P(), spec_kv, spec_kv),
             )
             outs, k_all, v_all = sharded(
@@ -212,14 +264,14 @@ class IciPipeline:
 
         return IciPipeline(
             cfg=cfg, mesh=mesh, num_stages=num_stages, num_micro=num_micro,
-            embed=embed, head=head, layers_stacked=layers, _step=step,
+            tp=tp, embed=embed, head=head, layers_stacked=layers, _step=step,
         )
 
     def init_kv(self, micro_batch: int, max_len: int, dtype=jnp.float32):
         k, v = init_pipeline_kv(
             self.cfg, self.num_stages, self.num_micro, micro_batch, max_len, dtype
         )
-        sh = NamedSharding(self.mesh, P("stage"))
+        sh = NamedSharding(self.mesh, _kv_spec(self.tp))
         return jax.device_put(k, sh), jax.device_put(v, sh)
 
     def forward(

@@ -1,0 +1,142 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+The reference has NO long-context story beyond single-server chunked prefill
+(SURVEY.md §5.7: no ring/Ulysses/blockwise anywhere; chunking at
+``petals/server/backend.py:129-143`` just bounds one GPU's peak memory). On
+TPU the natural long-context design is to shard the SEQUENCE across an
+intra-stage mesh axis: each device holds a slice of queries and a slice of
+keys/values, and the KV slices rotate around the ring via ``ppermute`` while
+every device accumulates its queries' attention with an online (flash-style)
+softmax. P devices => P× longer context at the same per-device HBM, with
+compute/communication overlap on ICI.
+
+Causality: query chunk q on device i covers absolute positions
+[i·C, i·C + C); after s ring steps a device holds the KV chunk of device
+(i - s) mod P. Blocks wholly in the future are masked out; the diagonal
+block applies the usual triangular mask. The rotation is always full-ring
+(simple, schedule-static); skipping fully-masked blocks is a later
+optimization.
+
+Numerics: scores and the softmax accumulator run in float32 regardless of the
+activation dtype (matching ops.attention's fp32-softmax contract); the output
+returns to the input dtype.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale):
+    # q: [B, Tq, Hkv, G, Dh]; k: [B, Tk, Hkv, Dh] -> [B, Hkv, G, Tq, Tk] f32
+    return jnp.einsum(
+        "bthgd,bshd->bhgts", q * scale, k, preferred_element_type=jnp.float32
+    )
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    *,
+    q_offset: Optional[jnp.ndarray] = None,
+    chunk_positions: Optional[jnp.ndarray] = None,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Exact attention with sequence sharded over `axis_name`.
+
+    Must be called inside shard_map/pjit manual context. Per-device views:
+      q: [B, C, H, Dh] — this device's query chunk;
+      k, v: [B, C, Hkv, Dh] — this device's KV chunk (same C).
+    q_offset: absolute position of this device's first query (defaults to
+    axis_index · C). Returns [B, C, H, Dh] in q.dtype.
+    """
+    del chunk_positions  # reserved for ragged chunks
+    b, c, h, dh = q.shape
+    hkv = k.shape[2]
+    groups = h // hkv
+    p = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    scale = dh ** -0.5
+
+    if q_offset is None:
+        q_offset = idx * c
+    q_pos = q_offset + jnp.arange(c, dtype=jnp.int32)          # [C]
+
+    qg = q.reshape(b, c, hkv, groups, dh)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def accumulate(s, k_blk, v_blk, m, l, o):
+        src = (idx - s) % p                                     # owner of k_blk
+        k_pos = src * c + jnp.arange(c, dtype=jnp.int32)        # [C]
+
+        scores = _block_scores(qg, k_blk, scale)                # [B,Hkv,G,C,C]
+        if causal:
+            allowed = k_pos[None, :] <= q_pos[:, None]          # [C, C]
+            scores = jnp.where(allowed[None, None, None], scores, NEG_INF)
+
+        blk_max = jnp.max(scores, axis=-1)                      # [B,Hkv,G,C]
+        m_new = jnp.maximum(m, blk_max)
+        # Guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1.
+        safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        corr = jnp.exp(m - safe_m)
+        probs = jnp.exp(scores - safe_m[..., None])
+        probs = jnp.where(scores <= NEG_INF / 2, 0.0, probs)
+        l = l * corr + probs.sum(axis=-1)
+        pv = jnp.einsum(
+            "bhgts,bshd->bthgd", probs.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32,
+        )                                                        # [B,C,Hkv,G,Dh]
+        o = o * corr.transpose(0, 3, 1, 2)[..., None] + pv
+        return m_new, l, o
+
+    def step(s, carry):
+        # Rotate FIRST, then accumulate: with the local block (s=0) peeled
+        # out of the loop, p-1 rotations cover all p blocks — rotating after
+        # the final accumulation would ship one dead ring hop of KV traffic.
+        k_blk, v_blk, m, l, o = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        m, l, o = accumulate(s, k_blk, v_blk, m, l, o)
+        return k_blk, v_blk, m, l, o
+
+    def vary(x):
+        return jax.lax.pcast(x, axis_name, to="varying")
+
+    m0 = vary(jnp.full((b, hkv, groups, c), NEG_INF, jnp.float32))
+    l0 = vary(jnp.zeros((b, hkv, groups, c), jnp.float32))
+    o0 = vary(jnp.zeros((b, c, hkv, groups, dh), jnp.float32))
+    m, l, o = accumulate(0, k, v, m0, l0, o0)                   # local block
+    _, _, m, l, o = jax.lax.fori_loop(1, p, step, (k, v, m, l, o))
+
+    denom = jnp.maximum(l, 1e-20).transpose(0, 3, 1, 2)[..., None]
+    out = (o / denom).reshape(b, c, h, dh)
+    return out.astype(q.dtype)
+
+
+def make_ring_attention_fn(mesh, axis_name: str = "sp"):
+    """shard_map-wrapped ring attention over full arrays.
+
+    q: [B, T, H, Dh]; k/v: [B, T, Hkv, Dh]; T must divide by the axis size.
+    Returns the full [B, T, H, Dh] output (sequence re-assembled).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis_name)
+
+    @jax.jit
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    def fn(q, k, v):
+        return ring_attention(q, k, v, axis_name)
+
+    return fn

@@ -1,0 +1,161 @@
+"""Tensor / expert parallelism for one pipeline stage via shard_map.
+
+The reference gets TP only through the external ``tensor_parallel`` package
+wrapping torch blocks (``petals/server/backend.py:43``, asserts every backend
+is a TensorParallel instance); MoE/EP exists only as config guards with no
+runnable code (SURVEY.md §2.3). Here both are first-class mesh axes:
+
+  * TP ("megatron"-style): q/k/v and mlp-in projections are column-sharded
+    over the ``tp`` axis, out-projections row-sharded, so each matmul pair
+    needs exactly ONE ``psum`` (already emitted inside
+    ``models.transformer`` when ``tp_axis`` is set). The KV cache shards
+    over kv heads — GQA requires ``num_kv_heads % tp == 0``.
+  * EP (MoE): expert weights shard over the same axis; the router stays
+    replicated so top-k routing is global, each device computes its local
+    experts' weighted contribution, and the same closing psum combines.
+
+Composability: the specs returned here are ordinary PartitionSpecs over one
+named axis, so a stage can run TP inside a pipeline stage's device group
+(mesh ("stage", "tp")) — the fused pipeline shard-maps over "stage" and this
+module's body runs inside it over "tp".
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..models.partition import StageSpec, stage_forward
+
+Params = Dict[str, Any]
+
+# Leaf-name -> which axis of the [L, ...] stacked leaf is sharded (None =
+# replicated). Column-parallel in, row-parallel out; see module docstring.
+_DENSE_TP_AXIS = {
+    ("attn", "wq"): 2, ("attn", "wk"): 2, ("attn", "wv"): 2, ("attn", "wo"): 1,
+    ("attn", "bq"): 1, ("attn", "bk"): 1, ("attn", "bv"): 1, ("attn", "bo"): None,
+    ("mlp", "wg"): 2, ("mlp", "wu"): 2, ("mlp", "wd"): 1,      # swiglu
+    ("mlp", "wi"): 2, ("mlp", "wo"): 1,                         # gelu_mlp
+    ("mlp", "bi"): 1, ("mlp", "bo"): None,
+    ("ln1", "w"): None, ("ln1", "b"): None,
+    ("ln2", "w"): None, ("ln2", "b"): None,
+}
+# MoE experts: shard the expert axis (EP); router replicated.
+_MOE_TP_AXIS = {
+    ("mlp", "router"): None,
+    ("mlp", "wg"): 1, ("mlp", "wu"): 1, ("mlp", "wd"): 1,
+}
+
+
+def layer_partition_specs(cfg: ModelConfig, axis: str = "tp"):
+    """Spec RESOLVER for stacked-layer leaves: returns a function
+    (tree_map_with_path path) -> PartitionSpec for a [L, ...] leaf. Use
+    `stage_param_specs` for a ready-made spec pytree over a whole stage."""
+
+    def spec_for(path) -> P:
+        key = tuple(p.key for p in path[-2:])
+        table = _MOE_TP_AXIS if cfg.is_moe and key[0] == "mlp" else _DENSE_TP_AXIS
+        shard_axis = table.get(key)
+        if shard_axis is None:
+            return P()
+        parts = [None] * (shard_axis + 1)
+        parts[shard_axis] = axis
+        return P(*parts)
+
+    return spec_for
+
+
+def stage_param_specs(cfg: ModelConfig, params: Params, axis: str = "tp") -> Params:
+    """PartitionSpec pytree for a stage's parameter shard: layer leaves get
+    the `_DENSE_TP_AXIS`/`_MOE_TP_AXIS` layout; embeddings, final norm, and
+    lm_head are replicated over the axis (the head's vocab matmul is
+    recomputed identically on each rank — cheap next to the layer stack, and
+    it keeps logits replicated for sampling). The single source of truth for
+    both placement (`shard_stage_params`) and shard_map in_specs
+    (`make_tp_stage_fn`)."""
+    spec_for = layer_partition_specs(cfg, axis)
+
+    def f(path, _leaf):
+        top = path[0].key if path else None
+        return spec_for(path) if top == "layers" else P()
+
+    return jax.tree_util.tree_map_with_path(f, params)
+
+
+def shard_stage_params(
+    cfg: ModelConfig, params: Params, mesh: Mesh, axis: str = "tp"
+) -> Params:
+    """Place a stage's parameter shard on the mesh with TP/EP layout."""
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params, stage_param_specs(cfg, params, axis),
+    )
+
+
+def validate_tp(cfg: ModelConfig, tp: int) -> None:
+    if cfg.num_heads % tp:
+        raise ValueError(f"num_heads {cfg.num_heads} % tp {tp} != 0")
+    if cfg.num_kv_heads % tp:
+        raise ValueError(
+            f"num_kv_heads {cfg.num_kv_heads} % tp {tp} != 0 "
+            "(GQA cache shards over kv heads)"
+        )
+    if cfg.is_moe and cfg.num_experts % tp:
+        raise ValueError(f"num_experts {cfg.num_experts} % tp {tp} != 0")
+    if not cfg.is_moe and cfg.intermediate_size % tp:
+        raise ValueError(f"intermediate_size {cfg.intermediate_size} % tp != 0")
+
+
+def make_tp_stage_fn(
+    cfg: ModelConfig,
+    spec: StageSpec,
+    mesh: Mesh,
+    axis: str = "tp",
+):
+    """Jitted TP stage forward. Caller passes params placed by
+    `shard_stage_params` and a KV cache sharded over kv heads
+    ([L, B, S, Hkv, Dh] with spec P(None, None, None, axis)).
+
+    Returns fn(params, x, k, v, cache_len) -> (out, k, v); out replicated.
+    """
+    tp = mesh.shape[axis]
+    validate_tp(cfg, tp)
+    kv_spec = P(None, None, None, axis)
+
+    def build(params_example: Params):
+        in_specs = (stage_param_specs(cfg, params_example, axis), P(),
+                    kv_spec, kv_spec, P())
+
+        @jax.jit
+        @partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=in_specs, out_specs=(P(), kv_spec, kv_spec),
+        )
+        def fn(params, x, k_cache, v_cache, cache_len):
+            out, k_cache, v_cache = stage_forward(
+                cfg, spec, params, x, k_cache, v_cache, cache_len,
+                tp_axis=axis,
+            )
+            # out is replicated by the closing psums (vma: psum output is
+            # axis-invariant), matching out_specs=P().
+            return out, k_cache, v_cache
+
+        return fn
+
+    return build
+
+
+def init_tp_kv(
+    cfg: ModelConfig, spec: StageSpec, mesh: Mesh, batch: int, max_len: int,
+    dtype=jnp.float32, axis: str = "tp",
+):
+    shape = (max(spec.num_layers, 1), batch, max_len, cfg.num_kv_heads,
+             cfg.head_dim)
+    sh = NamedSharding(mesh, P(None, None, None, axis))
+    return (jax.device_put(jnp.zeros(shape, dtype), sh),
+            jax.device_put(jnp.zeros(shape, dtype), sh))
