@@ -1,0 +1,349 @@
+"""CLI entry point — the reference's flag surface on the TPU-native runtime.
+
+Mirrors ``src/main.py:775-838`` (argparse, role dispatch) with the stages
+re-homed: on a TPU host the whole pipeline lives in one process, so
+``--stage N`` processes become execution MODES:
+
+  * ``--mode local``  — in-process cluster: fixed-split or load-balancing
+    stage servers + the pipeline client, one generation end-to-end. This is
+    also the ``scripts/run_all.py`` role (component 17): the reference
+    spawned 4 subprocesses and scraped their logs; here the same topology is
+    constructed directly.
+  * ``--mode fused``  — the ICI hot path: all stages in one jitted program
+    on a ("stage"[, "tp"]) device mesh (microbatched pipelined decode).
+  * ``--mode oracle`` — unpartitioned single-device generation
+    (``scripts/single_gpu_check.py``, component 19): the correctness/speed
+    baseline with identical sampling.
+
+Model weights: ``--checkpoint`` loads a local HF checkpoint directory via
+transformers (offline; no downloads — zero-egress environments). Without a
+checkpoint, weights are random-initialized from the ``--model`` preset, which
+still exercises every runtime path. Tokenization uses the checkpoint's
+tokenizer when available, else a UTF-8 byte fallback so the CLI always runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import random
+import sys
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .models import full_forward, get_config, init_kv_cache, init_params
+from .models.config import ModelConfig
+from .models.partition import StagePlan, parse_splits, slice_stage_params
+from .ops.sampling import SamplingParams
+from .runtime.client import PipelineClient, make_server_record
+from .runtime.executor import StageExecutor
+from .runtime.server import ElasticStageServer
+from .runtime.transport import LocalTransport
+from .scheduling.registry import PlacementRegistry
+
+logger = logging.getLogger("mini_petals_tpu")
+
+
+# ---------------------------------------------------------------------------
+# Tokenizer (checkpoint tokenizer, else byte-level fallback)
+# ---------------------------------------------------------------------------
+
+class ByteTokenizer:
+    """UTF-8 byte fallback: token id = byte value. Keeps the CLI runnable
+    with random-init models in zero-egress environments."""
+
+    eos_token_id: Optional[int] = None
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(int(i) % 256 for i in ids).decode("utf-8", errors="replace")
+
+
+def load_tokenizer(checkpoint: Optional[str]):
+    if checkpoint:
+        try:
+            from transformers import AutoTokenizer
+
+            return AutoTokenizer.from_pretrained(checkpoint, local_files_only=True)
+        except Exception as exc:
+            logger.warning("tokenizer load failed (%s); using byte fallback", exc)
+    return ByteTokenizer()
+
+
+def load_model(args) -> Tuple[ModelConfig, dict]:
+    if args.dtype == "float16":
+        # TPUs have no fp16 compute path; bf16 differs numerically (8-bit
+        # exponent / 7-bit mantissa vs 5/10) so an fp16 baseline will not
+        # reproduce bit-for-bit.
+        logger.warning("--dtype float16 runs as bfloat16 on TPU")
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+             "float16": jnp.bfloat16}[args.dtype]
+    if args.checkpoint:
+        import torch
+        from transformers import AutoModelForCausalLM
+
+        from .models.hf_import import config_from_hf, convert_state_dict
+
+        torch.manual_seed(0)
+        hf = AutoModelForCausalLM.from_pretrained(
+            args.checkpoint, local_files_only=True, torch_dtype=torch.float32
+        )
+        cfg = config_from_hf(hf.config)
+        params = convert_state_dict(cfg, hf.state_dict(), dtype=np.float32)
+        if dtype != jnp.float32:
+            params = jax.tree.map(lambda x: x.astype(dtype), params)
+        return cfg, params
+    cfg = get_config(args.model)
+    logger.info("no --checkpoint: random-initializing %s (%d layers)",
+                args.model, cfg.num_layers)
+    return cfg, init_params(jax.random.PRNGKey(args.seed), cfg, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Modes
+# ---------------------------------------------------------------------------
+
+def run_local(args, cfg: ModelConfig, params) -> int:
+    """In-process cluster: servers (fixed or LB) + client, one generation."""
+    splits = parse_splits(args.splits) if args.splits else None
+    if splits is None:
+        plan = StagePlan.even(cfg.num_layers, 4)
+    else:
+        plan = StagePlan.from_splits(cfg.num_layers, splits)
+
+    transport = LocalTransport()
+    registry = PlacementRegistry(rng=random.Random(args.seed))
+    provider = lambda spec: slice_stage_params(cfg, params, spec)  # noqa: E731
+
+    if args.use_load_balancing:
+        min_block = plan.stages[0].end
+        num_blocks = args.num_blocks or max(
+            1, (cfg.num_layers - min_block) // max(plan.num_stages - 1, 1))
+        for i in range(args.num_servers):
+            ElasticStageServer(
+                f"server-{i}", cfg, provider, registry, transport,
+                num_blocks=num_blocks,
+                total_blocks=args.total_blocks or cfg.num_layers,
+                min_block=min_block,
+                balance_quality=args.balance_quality,
+                mean_balance_check_period=args.mean_balance_check_period,
+                bandwidth_mbps=args.network_bandwidth_mbps,
+                rng=random.Random(args.seed + i),
+            ).start_serving()
+    else:
+        for spec in plan.stages[1:]:
+            peer = f"server-stage{spec.index}"
+            ex = StageExecutor(cfg, spec, provider(spec), peer_id=peer)
+            transport.add_peer(peer, ex)
+            registry.register(make_server_record(peer, spec))
+
+    stage0 = StageExecutor(cfg, plan.stages[0], provider(plan.stages[0]),
+                           peer_id="client-local")
+    client = PipelineClient(
+        cfg, plan, stage0, transport, registry,
+        use_module_routing=bool(args.use_load_balancing),
+        total_blocks=args.total_blocks or cfg.num_layers,
+        request_timeout=args.request_timeout,
+        seed=args.seed,
+    )
+    return _generate_and_report(args, client.generate, cfg)
+
+
+def run_fused(args, cfg: ModelConfig, params) -> int:
+    """Fused ICI pipeline generation (microbatch=1 stream for the CLI)."""
+    from .parallel.pipeline import IciPipeline
+
+    num_stages = args.num_stages or max(1, min(len(jax.devices()) // args.tp, 4))
+    while cfg.num_layers % num_stages:
+        num_stages -= 1
+    pipe = IciPipeline.build(cfg, params, num_stages=num_stages,
+                             num_micro=1, tp=args.tp)
+    logger.info("fused pipeline: %d stages x tp=%d on %s",
+                num_stages, args.tp, pipe.mesh.devices.ravel())
+
+    def generate(prompt_ids, max_new_tokens, sampling, eos_token_id=None,
+                 **_kw):
+        from .runtime.client import GenerationResult
+
+        max_len = len(prompt_ids) + max_new_tokens + 1
+        kv_dtype = pipe.embed["wte"].dtype
+        k, v = pipe.init_kv(1, max(128, max_len), dtype=kv_dtype)
+        ids = jnp.asarray(np.asarray(prompt_ids, np.int32)[None, None, :])
+        t0 = time.monotonic()
+        logits, k, v = pipe.forward(ids, k, v, jnp.int32(0))
+        tok = int(jnp.argmax(logits[0, 0, -1]))
+        ttft = time.monotonic() - t0
+        tokens = [tok]
+        cur = len(prompt_ids)
+        decode_times = []
+        stopped = "max_tokens"
+        for _ in range(max_new_tokens - 1):
+            if eos_token_id is not None and tokens[-1] == eos_token_id:
+                stopped = "eos"
+                break
+            if len(tokens) >= 5 and len(set(tokens[-5:])) == 1:
+                stopped = "repeat"
+                break
+            t0 = time.monotonic()
+            step = jnp.asarray([[[tokens[-1]]]], jnp.int32)
+            logits, k, v = pipe.forward(step, k, v, jnp.int32(cur))
+            tokens.append(int(jnp.argmax(logits[0, 0, -1])))
+            decode_times.append(time.monotonic() - t0)
+            cur += 1
+        return GenerationResult(tokens=tokens, ttft_s=ttft,
+                                decode_times_s=decode_times, stopped_by=stopped)
+
+    if args.temperature > 0:
+        logger.warning("fused mode samples greedily (temperature ignored)")
+    return _generate_and_report(args, generate, cfg)
+
+
+def run_oracle(args, cfg: ModelConfig, params) -> int:
+    """Single-device unpartitioned generation (scripts/single_gpu_check.py)."""
+    from .ops.sampling import RECENT_WINDOW, sample_token
+
+    def generate(prompt_ids, max_new_tokens, sampling, eos_token_id=None,
+                 **_kw):
+        from .runtime.client import GenerationResult
+
+        max_len = len(prompt_ids) + max_new_tokens + 1
+        kc, vc = init_kv_cache(cfg, cfg.num_layers, 1, max(128, max_len),
+                               dtype=params["embed"]["wte"].dtype)
+        ids = jnp.asarray(np.asarray(prompt_ids, np.int32)[None, :])
+        tokens: List[int] = []
+
+        def pick(last_logits, step):
+            recent = np.zeros((RECENT_WINDOW,), np.int32)
+            n = min(len(tokens), RECENT_WINDOW)
+            if n:
+                recent[:n] = np.asarray(tokens[-n:], np.int32)
+            return int(sample_token(
+                jax.random.PRNGKey(args.seed + step), last_logits,
+                jnp.asarray(recent), jnp.asarray(n, jnp.int32),
+                jnp.asarray(sampling.temperature, jnp.float32),
+                jnp.asarray(sampling.top_p, jnp.float32),
+                jnp.asarray(sampling.top_k, jnp.int32),
+                jnp.asarray(sampling.repetition_penalty, jnp.float32),
+            ))
+
+        t0 = time.monotonic()
+        logits, kc, vc = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
+        tokens.append(pick(logits[0, -1], 0))
+        ttft = time.monotonic() - t0
+        cur = len(prompt_ids)
+        decode_times = []
+        stopped = "max_tokens"
+        for step in range(1, max_new_tokens):
+            if eos_token_id is not None and tokens[-1] == eos_token_id:
+                stopped = "eos"
+                break
+            if len(tokens) >= 5 and len(set(tokens[-5:])) == 1:
+                stopped = "repeat"
+                break
+            t0 = time.monotonic()
+            nxt = jnp.asarray([[tokens[-1]]], jnp.int32)
+            logits, kc, vc = full_forward(cfg, params, nxt, kc, vc,
+                                          jnp.int32(cur))
+            tokens.append(pick(logits[0, 0], step))
+            decode_times.append(time.monotonic() - t0)
+            cur += 1
+        return GenerationResult(tokens=tokens, ttft_s=ttft,
+                                decode_times_s=decode_times, stopped_by=stopped)
+
+    return _generate_and_report(args, generate, cfg)
+
+
+def _generate_and_report(args, generate_fn, cfg: ModelConfig) -> int:
+    tokenizer = load_tokenizer(args.checkpoint)
+    prompt_ids = tokenizer.encode(args.prompt)
+    prompt_ids = [i % cfg.vocab_size for i in prompt_ids]
+    sampling = SamplingParams(
+        temperature=args.temperature, top_p=args.top_p, top_k=args.top_k,
+        repetition_penalty=args.repetition_penalty,
+    )
+    eos = getattr(tokenizer, "eos_token_id", None)
+
+    res = generate_fn(prompt_ids, args.max_new_tokens, sampling=sampling,
+                      eos_token_id=eos)
+    text = tokenizer.decode(res.tokens)
+    # The reference's closing report (src/main.py:213-225): TTFT, decode
+    # time, tokens/s.
+    print(f"\n=== Generation ({len(res.tokens)} tokens, "
+          f"stopped by {res.stopped_by}) ===")
+    print(text)
+    print(f"\nTTFT: {res.ttft_s:.3f}s")
+    total_decode = sum(res.decode_times_s)
+    print(f"Decode: {total_decode:.3f}s total, "
+          f"{res.decode_tokens_per_s:.2f} tokens/s")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Argparse (reference flag table, src/main.py:776-819)
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.main",
+        description="TPU-native distributed LLM inference (mini-Petals parity)",
+    )
+    p.add_argument("--mode", choices=["local", "fused", "oracle"],
+                   default="local")
+    p.add_argument("--model", default="gpt2",
+                   help="architecture preset (gpt2[-xl], llama-3-8b, ...)")
+    p.add_argument("--checkpoint", default=None,
+                   help="local HF checkpoint dir (offline); omit for random init")
+    p.add_argument("--splits", default=None,
+                   help='stage boundaries, e.g. "10,20,30" (reference format)')
+    p.add_argument("--stage", type=int, default=0,
+                   help="accepted for reference-CLI parity; stages are "
+                        "in-process on TPU")
+    p.add_argument("--dtype", choices=["float32", "bfloat16", "float16"],
+                   default="float32")
+    p.add_argument("--prompt", default="Hello, my name is")
+    p.add_argument("--max_new_tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.7)
+    p.add_argument("--top_p", type=float, default=0.9)
+    p.add_argument("--top_k", type=int, default=50)
+    p.add_argument("--repetition_penalty", type=float, default=1.5)
+    p.add_argument("--request_timeout", type=float, default=60.0)
+    # Load balancing (reference LB flag group)
+    p.add_argument("--use_load_balancing", action="store_true")
+    p.add_argument("--num_blocks", type=int, default=None)
+    p.add_argument("--total_blocks", type=int, default=None)
+    p.add_argument("--num_servers", type=int, default=3)
+    p.add_argument("--balance_quality", type=float, default=0.75)
+    p.add_argument("--mean_balance_check_period", type=float, default=120.0)
+    p.add_argument("--network_bandwidth_mbps", type=float, default=None)
+    # TPU-native knobs
+    p.add_argument("--num_stages", type=int, default=None,
+                   help="fused mode: pipeline depth (default: #devices, <=4)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="fused mode: tensor parallelism per stage")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    cfg, params = load_model(args)
+    if args.mode == "local":
+        return run_local(args, cfg, params)
+    if args.mode == "fused":
+        return run_fused(args, cfg, params)
+    return run_oracle(args, cfg, params)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
