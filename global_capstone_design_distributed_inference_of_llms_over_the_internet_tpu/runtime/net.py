@@ -1,0 +1,540 @@
+"""TCP data plane + registry service — the multi-host transport.
+
+Replaces the reference's hivemind stack (Go libp2p daemon + protobuf
+``ExpertRequest``/``ExpertResponse`` + msgpack metadata sidecar + Kademlia
+DHT; SURVEY.md §2.3/§5.8) with a dependency-free framed protocol:
+
+  frame = MAGIC(4) | header_len(u32) | header JSON | payload | crc32c(u32)
+
+The header carries the verb + the request metadata (exactly the reference's
+metadata schema: session_id, seq_len, cur_len, is_prefill, is_replay,
+max_length, sampling knobs, generated_tokens[-50:], block range — Appendix B
+of SURVEY.md); the payload is the raw activation tensor, fp32 or wire-bf16
+(the reference ships fp16 — same halved-payload tradeoff), converted by the
+native codec (C++ via ctypes, numpy fallback) and integrity-checked with
+CRC-32C (TCP's 16-bit checksum is weak at multi-MB payloads on WAN links).
+
+Components:
+  * `TcpStageServer` — serves one `StageExecutor` (verbs: forward,
+    end_session, info — `info` mirrors Petals' ``rpc_info``,
+    ``petals/server/handler.py:575-592``);
+  * `TcpTransport` — the client side of `runtime.transport.Transport`;
+    resolves peer addresses from registry records, keeps one persistent
+    connection per peer, maps socket errors onto the retryable taxonomy;
+  * `RegistryServer`/`RemoteRegistry` — the control plane: a tiny JSON-RPC
+    registry every process points at (register/heartbeat/list), replacing
+    the Kademlia DHT for discovery + liveness. TTL expiry runs server-side.
+
+The elastic/fault-tolerance machinery (journal replay, failover, LB) is
+transport-agnostic and works unchanged on top of this.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import native
+from ..ops.sampling import SamplingParams
+from ..scheduling.registry import PlacementRegistry, ServerRecord
+from .executor import StageExecutionError, StageExecutor
+from .messages import StageRequest, StageResponse
+from .transport import PeerUnavailable, Transport
+
+logger = logging.getLogger(__name__)
+
+MAGIC = b"MPT1"
+MAX_FRAME = 1 << 30
+
+
+class WireError(ConnectionError):
+    """Malformed or corrupted frame."""
+
+
+# ---------------------------------------------------------------------------
+# Framing
+# ---------------------------------------------------------------------------
+
+def _send_frame(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
+    hdr = json.dumps(header).encode()
+    crc = native.crc32c(payload)
+    sock.sendall(
+        MAGIC + struct.pack("<I", len(hdr)) + hdr
+        + struct.pack("<I", len(payload)) + payload + struct.pack("<I", crc)
+    )
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
+    magic = _recv_exact(sock, 4)
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    (hlen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if hlen > MAX_FRAME:
+        raise WireError(f"oversized header {hlen}")
+    header = json.loads(_recv_exact(sock, hlen))
+    (plen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if plen > MAX_FRAME:
+        raise WireError(f"oversized payload {plen}")
+    payload = _recv_exact(sock, plen)
+    (crc,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if crc != native.crc32c(payload):
+        raise WireError("payload checksum mismatch")
+    return header, payload
+
+
+def _encode_tensor(arr: np.ndarray, wire_dtype: str) -> Tuple[dict, bytes]:
+    meta = {"shape": list(arr.shape)}
+    if arr.dtype == np.int32:
+        meta["dtype"] = "int32"
+        return meta, np.ascontiguousarray(arr).tobytes()
+    if wire_dtype == "bf16":
+        meta["dtype"] = "bf16"
+        return meta, native.fp32_to_bf16_bytes(np.asarray(arr, np.float32))
+    meta["dtype"] = "f32"
+    return meta, np.ascontiguousarray(arr, np.float32).tobytes()
+
+
+def _decode_tensor(meta: dict, payload: bytes) -> np.ndarray:
+    shape = tuple(meta["shape"])
+    if meta["dtype"] == "int32":
+        return np.frombuffer(payload, np.int32).reshape(shape)
+    if meta["dtype"] == "bf16":
+        return native.bf16_bytes_to_fp32(payload, shape)
+    return np.frombuffer(payload, np.float32).reshape(shape).copy()
+
+
+def _request_header(req: StageRequest, tensor_meta: dict) -> dict:
+    return {
+        "verb": "forward",
+        "session_id": req.session_id,
+        "seq_len": req.seq_len,
+        "cur_len": req.cur_len,
+        "is_prefill": req.is_prefill,
+        "is_replay": req.is_replay,
+        "max_length": req.max_length,
+        "temperature": req.sampling.temperature,
+        "top_p": req.sampling.top_p,
+        "top_k": req.sampling.top_k,
+        "repetition_penalty": req.sampling.repetition_penalty,
+        "generated_tokens": list(req.generated_tokens),
+        "step_seed": req.step_seed,
+        "start_block": req.start_block,
+        "end_block": req.end_block,
+        "tensor": tensor_meta,
+    }
+
+
+def _header_to_request(h: dict, payload: bytes) -> StageRequest:
+    arr = _decode_tensor(h["tensor"], payload)
+    return StageRequest(
+        session_id=h["session_id"],
+        hidden=jnp.asarray(arr),
+        seq_len=h["seq_len"],
+        cur_len=h["cur_len"],
+        is_prefill=h["is_prefill"],
+        is_replay=h.get("is_replay", False),
+        max_length=h["max_length"],
+        sampling=SamplingParams(
+            temperature=h["temperature"], top_p=h["top_p"], top_k=h["top_k"],
+            repetition_penalty=h["repetition_penalty"],
+        ),
+        generated_tokens=tuple(h.get("generated_tokens", ())),
+        step_seed=h.get("step_seed", 0),
+        start_block=h.get("start_block"),
+        end_block=h.get("end_block"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Stage server
+# ---------------------------------------------------------------------------
+
+class TcpStageServer:
+    """Serves one StageExecutor over TCP (the ``StageConnectionHandler``
+    role, ``src/rpc_handler.py:43``)."""
+
+    def __init__(self, executor: StageExecutor, host: str = "127.0.0.1",
+                 port: int = 0, wire_dtype: str = "bf16"):
+        self.executor = executor
+        self.wire_dtype = wire_dtype
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        header, payload = _recv_frame(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    try:
+                        outer._dispatch(self.request, header, payload)
+                    except (ConnectionError, OSError):
+                        return
+                    except Exception as exc:  # report, keep serving
+                        logger.exception("request failed")
+                        try:
+                            _send_frame(self.request,
+                                        {"verb": "error", "message": str(exc)})
+                        except OSError:
+                            return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.address = "%s:%d" % self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        logger.info("stage server %s on %s (span [%d, %d))",
+                    self.executor.peer_id, self.address,
+                    self.executor.spec.start, self.executor.spec.end)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def _dispatch(self, sock, header: dict, payload: bytes) -> None:
+        verb = header.get("verb")
+        if verb == "forward":
+            req = _header_to_request(header, payload)
+            try:
+                resp = self.executor.forward(req)
+            except StageExecutionError as exc:
+                _send_frame(sock, {"verb": "error", "message": str(exc),
+                                   "kind": "stage"})
+                return
+            if resp.is_token:
+                _send_frame(sock, {
+                    "verb": "token", "session_id": resp.session_id,
+                    "token_id": resp.token_id, "cache_len": resp.cache_len,
+                })
+            else:
+                arr = np.asarray(resp.hidden)
+                meta, body = _encode_tensor(arr, self.wire_dtype)
+                _send_frame(sock, {
+                    "verb": "hidden", "session_id": resp.session_id,
+                    "cache_len": resp.cache_len, "tensor": meta,
+                }, body)
+        elif verb == "end_session":
+            self.executor.drop_session(header["session_id"])
+            _send_frame(sock, {"verb": "ok"})
+        elif verb == "info":
+            spec = self.executor.spec
+            _send_frame(sock, {
+                "verb": "info", "peer_id": self.executor.peer_id,
+                "start_block": spec.start, "end_block": spec.end,
+                "cache_tokens_left": self.executor.arena.tokens_left(),
+                "requests_served": self.executor.requests_served,
+                "version": 1,
+            })
+        else:
+            _send_frame(sock, {"verb": "error",
+                               "message": f"unknown verb {verb!r}"})
+
+
+# ---------------------------------------------------------------------------
+# Client transport
+# ---------------------------------------------------------------------------
+
+class TcpTransport(Transport):
+    """Client-side transport resolving peers via registry `address` fields."""
+
+    def __init__(self, registry, wire_dtype: str = "bf16",
+                 connect_timeout: float = 5.0):
+        self.registry = registry
+        self.wire_dtype = wire_dtype
+        self.connect_timeout = connect_timeout
+        self._conns: Dict[str, socket.socket] = {}
+        self._lock = threading.Lock()
+
+    def _addr(self, peer_id: str) -> Tuple[str, int]:
+        rec = self.registry.get(peer_id)
+        if rec is None or not rec.address:
+            raise PeerUnavailable(f"no address for peer {peer_id}")
+        host, port = rec.address.rsplit(":", 1)
+        return host, int(port)
+
+    def _connect(self, peer_id: str) -> socket.socket:
+        with self._lock:
+            sock = self._conns.get(peer_id)
+        if sock is not None:
+            return sock
+        host, port = self._addr(peer_id)
+        try:
+            sock = socket.create_connection((host, port),
+                                            timeout=self.connect_timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError as exc:
+            raise PeerUnavailable(f"cannot reach {peer_id} at {host}:{port}: {exc}")
+        with self._lock:
+            self._conns[peer_id] = sock
+        return sock
+
+    def _drop(self, peer_id: str) -> None:
+        with self._lock:
+            sock = self._conns.pop(peer_id, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def alive(self, peer_id: str) -> bool:
+        try:
+            self._addr(peer_id)
+            return True
+        except PeerUnavailable:
+            return False
+
+    def call(self, peer_id: str, request: StageRequest,
+             timeout: Optional[float] = None) -> StageResponse:
+        sock = self._connect(peer_id)
+        try:
+            sock.settimeout(timeout)
+            arr = np.asarray(request.hidden)
+            meta, body = _encode_tensor(arr, self.wire_dtype)
+            _send_frame(sock, _request_header(request, meta), body)
+            header, payload = _recv_frame(sock)
+        except socket.timeout as exc:
+            self._drop(peer_id)
+            raise TimeoutError(f"peer {peer_id} timed out") from exc
+        except (ConnectionError, OSError) as exc:
+            self._drop(peer_id)
+            raise PeerUnavailable(f"peer {peer_id} connection failed: {exc}")
+        verb = header.get("verb")
+        if verb == "token":
+            return StageResponse(
+                session_id=header["session_id"],
+                token_id=header["token_id"], cache_len=header["cache_len"],
+            )
+        if verb == "hidden":
+            return StageResponse(
+                session_id=header["session_id"],
+                hidden=jnp.asarray(_decode_tensor(header["tensor"], payload)),
+                cache_len=header["cache_len"],
+            )
+        if verb == "error":
+            if header.get("kind") == "stage":
+                raise StageExecutionError(header.get("message", "stage error"))
+            raise RuntimeError(f"peer {peer_id} error: {header.get('message')}")
+        raise WireError(f"unexpected response verb {verb!r}")
+
+    def end_session(self, peer_id: str, session_id: str) -> None:
+        try:
+            sock = self._connect(peer_id)
+            sock.settimeout(self.connect_timeout)
+            _send_frame(sock, {"verb": "end_session", "session_id": session_id})
+            _recv_frame(sock)
+        except (PeerUnavailable, TimeoutError, ConnectionError, OSError):
+            self._drop(peer_id)
+
+    def info(self, peer_id: str, timeout: float = 5.0) -> dict:
+        sock = self._connect(peer_id)
+        try:
+            sock.settimeout(timeout)
+            _send_frame(sock, {"verb": "info"})
+            header, _ = _recv_frame(sock)
+            return header
+        except (ConnectionError, OSError) as exc:
+            self._drop(peer_id)
+            raise PeerUnavailable(f"peer {peer_id}: {exc}")
+
+    def close(self) -> None:
+        with self._lock:
+            conns, self._conns = dict(self._conns), {}
+        for sock in conns.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Registry service (control plane)
+# ---------------------------------------------------------------------------
+
+_REC_FIELDS = ("peer_id", "start_block", "end_block", "throughput", "state",
+               "final_stage", "stage_index", "cache_tokens_left", "address")
+
+
+def _rec_to_dict(rec: ServerRecord) -> dict:
+    return {f: getattr(rec, f) for f in _REC_FIELDS}
+
+
+def _dict_to_rec(d: dict) -> ServerRecord:
+    return ServerRecord(**{f: d.get(f) for f in _REC_FIELDS})
+
+
+class RegistryServer:
+    """JSON-over-TCP registry service backed by a PlacementRegistry."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 ttl: float = 45.0):
+        self.registry = PlacementRegistry(ttl=ttl)
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        header, _ = _recv_frame(self.request)
+                        _send_frame(self.request, outer._dispatch(header))
+                    except (ConnectionError, OSError):
+                        return
+                    except Exception as exc:
+                        logger.exception("registry request failed")
+                        try:
+                            _send_frame(self.request,
+                                        {"verb": "error", "message": str(exc)})
+                        except OSError:
+                            return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.address = "%s:%d" % self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def _dispatch(self, h: dict) -> dict:
+        verb = h.get("verb")
+        if verb == "register":
+            self.registry.register(_dict_to_rec(h["record"]))
+            return {"verb": "ok"}
+        if verb == "heartbeat":
+            ok = self.registry.heartbeat(
+                h["peer_id"], throughput=h.get("throughput"),
+                cache_tokens_left=h.get("cache_tokens_left"))
+            return {"verb": "ok", "known": ok}
+        if verb == "unregister":
+            self.registry.unregister(h["peer_id"])
+            return {"verb": "ok"}
+        if verb == "list":
+            return {"verb": "records",
+                    "records": [_rec_to_dict(r)
+                                for r in self.registry.live_servers()]}
+        return {"verb": "error", "message": f"unknown verb {verb!r}"}
+
+
+class RemoteRegistry:
+    """Client for RegistryServer with the PlacementRegistry query surface.
+
+    Queries fetch the full live-record list and evaluate locally — the same
+    read-everything pattern as the reference's ``get_remote_module_infos``
+    DHT scan (``src/dht_utils.py:147-242``). Fine at mini-Petals swarm sizes.
+    """
+
+    def __init__(self, address: str, timeout: float = 5.0,
+                 rng: Optional["np.random.Generator"] = None):
+        host, port = address.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        import random as _random
+
+        self._local = PlacementRegistry(rng=_random.Random(0))
+        self.ttl = self._local.ttl
+
+    def _rpc(self, header: dict) -> dict:
+        with self._lock:
+            if self._sock is None:
+                self._sock = socket.create_connection(
+                    self._addr, timeout=self.timeout)
+            try:
+                _send_frame(self._sock, header)
+                resp, _ = _recv_frame(self._sock)
+                return resp
+            except (ConnectionError, OSError):
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+                raise
+
+    # -- write path ---------------------------------------------------------
+
+    def register(self, record: ServerRecord, ttl: Optional[float] = None) -> None:
+        del ttl  # server-side TTL policy
+        self._rpc({"verb": "register", "record": _rec_to_dict(record)})
+
+    def heartbeat(self, peer_id: str, throughput: Optional[float] = None,
+                  cache_tokens_left: Optional[int] = None) -> bool:
+        resp = self._rpc({"verb": "heartbeat", "peer_id": peer_id,
+                          "throughput": throughput,
+                          "cache_tokens_left": cache_tokens_left})
+        return bool(resp.get("known"))
+
+    def unregister(self, peer_id: str) -> None:
+        self._rpc({"verb": "unregister", "peer_id": peer_id})
+
+    def set_state(self, peer_id: str, state: str) -> None:
+        rec = self.get(peer_id)
+        if rec is not None:
+            rec.state = state
+            self.register(rec)
+
+    # -- read path (local evaluation over fetched records) ------------------
+
+    def _refresh(self) -> None:
+        resp = self._rpc({"verb": "list"})
+        import random as _random
+
+        fresh = PlacementRegistry(rng=_random.Random(0))
+        for d in resp.get("records", []):
+            fresh.register(_dict_to_rec(d))
+        self._local = fresh
+
+    def live_servers(self):
+        self._refresh()
+        return self._local.live_servers()
+
+    def get(self, peer_id: str):
+        self._refresh()
+        return self._local.get(peer_id)
+
+    def discover_stage(self, stage_index: int, exclude=()):
+        self._refresh()
+        return self._local.discover_stage(stage_index, exclude)
+
+    def discover_block(self, block: int, exclude=()):
+        self._refresh()
+        return self._local.discover_block(block, exclude)
+
+    def coverage(self, total_blocks: int):
+        self._refresh()
+        return self._local.coverage(total_blocks)

@@ -56,6 +56,7 @@ class ServerRecord:
     final_stage: bool = False
     stage_index: Optional[int] = None      # fixed-split mode stage number
     cache_tokens_left: Optional[int] = None  # petals/server/server.py:721
+    address: Optional[str] = None          # "host:port" for the TCP data plane
     timestamp: float = dataclasses.field(default_factory=time.monotonic)
     expires_at: float = 0.0
 
