@@ -165,17 +165,24 @@ def _header_to_request(h: dict, payload: bytes) -> StageRequest:
 
 
 # ---------------------------------------------------------------------------
-# Stage server
+# Framed-protocol server base
 # ---------------------------------------------------------------------------
 
-class TcpStageServer:
-    """Serves one StageExecutor over TCP (the ``StageConnectionHandler``
-    role, ``src/rpc_handler.py:43``)."""
+class _FramedTcpServer:
+    """Threaded TCP server speaking the framed protocol; subclasses implement
+    per-frame handling via `_dispatch(sock, header, payload)`.
 
-    def __init__(self, executor: StageExecutor, host: str = "127.0.0.1",
-                 port: int = 0, wire_dtype: str = "bf16"):
-        self.executor = executor
-        self.wire_dtype = wire_dtype
+    `stop()` severs established connections, not just the listener — a
+    stopped server must look dead to clients (the failover path depends on
+    it). Connections are tracked in `process_request`, which runs on the
+    accept-loop thread, so every connection accepted before `shutdown()`
+    returns is in the set — no handler-thread startup race.
+    """
+
+    def __init__(self, host: str, port: int):
+        active_lock = threading.Lock()
+        active: set = set()
+        self._active_lock, self._active = active_lock, active
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -201,6 +208,16 @@ class TcpStageServer:
             daemon_threads = True
             allow_reuse_address = True
 
+            def process_request(self, request, client_address):
+                with active_lock:
+                    active.add(request)
+                super().process_request(request, client_address)
+
+            def shutdown_request(self, request):
+                with active_lock:
+                    active.discard(request)
+                super().shutdown_request(request)
+
         self._server = Server((host, port), Handler)
         self.address = "%s:%d" % self._server.server_address
         self._thread: Optional[threading.Thread] = None
@@ -209,13 +226,44 @@ class TcpStageServer:
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
         self._thread.start()
-        logger.info("stage server %s on %s (span [%d, %d))",
-                    self.executor.peer_id, self.address,
-                    self.executor.spec.start, self.executor.spec.end)
 
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        with self._active_lock:
+            active = list(self._active)
+        for sock in active:
+            # shutdown() only: socketserver's shutdown_request closes the fd
+            # once the handler thread returns; closing here too would race
+            # fd reuse with threads still blocked in recv().
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def _dispatch(self, sock, header: dict, payload: bytes) -> None:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Stage server
+# ---------------------------------------------------------------------------
+
+class TcpStageServer(_FramedTcpServer):
+    """Serves one StageExecutor over TCP (the ``StageConnectionHandler``
+    role, ``src/rpc_handler.py:43``)."""
+
+    def __init__(self, executor: StageExecutor, host: str = "127.0.0.1",
+                 port: int = 0, wire_dtype: str = "bf16"):
+        self.executor = executor
+        self.wire_dtype = wire_dtype
+        super().__init__(host, port)
+
+    def start(self) -> None:
+        super().start()
+        logger.info("stage server %s on %s (span [%d, %d))",
+                    self.executor.peer_id, self.address,
+                    self.executor.spec.start, self.executor.spec.end)
 
     def _dispatch(self, sock, header: dict, payload: bytes) -> None:
         verb = header.get("verb")
@@ -389,48 +437,19 @@ def _dict_to_rec(d: dict) -> ServerRecord:
     return ServerRecord(**{f: d.get(f) for f in _REC_FIELDS})
 
 
-class RegistryServer:
+class RegistryServer(_FramedTcpServer):
     """JSON-over-TCP registry service backed by a PlacementRegistry."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  ttl: float = 45.0):
         self.registry = PlacementRegistry(ttl=ttl)
-        outer = self
+        super().__init__(host, port)
 
-        class Handler(socketserver.BaseRequestHandler):
-            def handle(self):
-                while True:
-                    try:
-                        header, _ = _recv_frame(self.request)
-                        _send_frame(self.request, outer._dispatch(header))
-                    except (ConnectionError, OSError):
-                        return
-                    except Exception as exc:
-                        logger.exception("registry request failed")
-                        try:
-                            _send_frame(self.request,
-                                        {"verb": "error", "message": str(exc)})
-                        except OSError:
-                            return
+    def _dispatch(self, sock, header: dict, payload: bytes) -> None:
+        del payload
+        _send_frame(sock, self._handle_verb(header))
 
-        class Server(socketserver.ThreadingTCPServer):
-            daemon_threads = True
-            allow_reuse_address = True
-
-        self._server = Server((host, port), Handler)
-        self.address = "%s:%d" % self._server.server_address
-        self._thread: Optional[threading.Thread] = None
-
-    def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True)
-        self._thread.start()
-
-    def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
-
-    def _dispatch(self, h: dict) -> dict:
+    def _handle_verb(self, h: dict) -> dict:
         verb = h.get("verb")
         if verb == "register":
             self.registry.register(_dict_to_rec(h["record"]))
