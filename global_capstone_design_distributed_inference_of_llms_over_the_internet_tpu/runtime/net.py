@@ -48,6 +48,7 @@ from ..ops.sampling import SamplingParams
 from ..scheduling.registry import PlacementRegistry, ServerRecord
 from .executor import StageExecutionError, StageExecutor
 from .messages import StageRequest, StageResponse
+from .task_pool import StageRuntime, TaskRejected
 from .transport import PeerUnavailable, Transport
 
 logger = logging.getLogger(__name__)
@@ -251,29 +252,70 @@ class _FramedTcpServer:
 
 class TcpStageServer(_FramedTcpServer):
     """Serves one StageExecutor over TCP (the ``StageConnectionHandler``
-    role, ``src/rpc_handler.py:43``)."""
+    role, ``src/rpc_handler.py:43``).
+
+    With a `StageRuntime`, each connection's handler thread submits compute
+    to the prioritized pools and blocks on the future — one compute thread
+    owns the chip while N handler threads own the sockets, the reference's
+    handlers→Runtime split (``petals/server/server.py:557-671``) without the
+    process boundary. Without one, compute runs on the handler thread
+    (single-client deployments)."""
 
     def __init__(self, executor: StageExecutor, host: str = "127.0.0.1",
-                 port: int = 0, wire_dtype: str = "bf16"):
+                 port: int = 0, wire_dtype: str = "bf16",
+                 runtime: Optional["StageRuntime"] = None,
+                 compute_timeout: float = 120.0,
+                 owns_runtime: bool = True):
         self.executor = executor
         self.wire_dtype = wire_dtype
+        self.runtime = runtime
+        self.compute_timeout = compute_timeout
+        # Several stage servers on one host may SHARE one runtime (one chip,
+        # one compute thread): only the owner may start/stop it, otherwise an
+        # elastic teardown of server A would kill server B's compute.
+        self.owns_runtime = owns_runtime
         super().__init__(host, port)
+
+    def _compute(self, kind: str, fn, *args, size: int = 1):
+        if self.runtime is None:
+            return fn(*args)
+        return self.runtime.call(kind, fn, *args, size=size,
+                                 timeout=self.compute_timeout)
 
     def start(self) -> None:
         super().start()
+        if self.runtime is not None and self.owns_runtime:
+            self.runtime.start()
         logger.info("stage server %s on %s (span [%d, %d))",
                     self.executor.peer_id, self.address,
                     self.executor.spec.start, self.executor.spec.end)
+
+    def stop(self) -> None:
+        super().stop()
+        if self.runtime is not None and self.owns_runtime:
+            self.runtime.stop()
 
     def _dispatch(self, sock, header: dict, payload: bytes) -> None:
         verb = header.get("verb")
         if verb == "forward":
             req = _header_to_request(header, payload)
             try:
-                resp = self.executor.forward(req)
-            except StageExecutionError as exc:
+                resp = self._compute("inference", self.executor.forward, req,
+                                     size=req.seq_len)
+            # All three map to kind="stage": the client converts that to
+            # StageExecutionError, which is in its retryable taxonomy
+            # (client.py failover) — a crashed generation helps nobody.
+            # TimeoutError must be caught here explicitly: on py>=3.11 it is
+            # an OSError subclass, and the outer handler's socket-error catch
+            # would otherwise silently drop the connection.
+            except (StageExecutionError, TaskRejected) as exc:
                 _send_frame(sock, {"verb": "error", "message": str(exc),
                                    "kind": "stage"})
+                return
+            except TimeoutError:
+                _send_frame(sock, {"verb": "error", "kind": "stage",
+                                   "message": f"stage compute timed out after "
+                                              f"{self.compute_timeout:.0f}s"})
                 return
             if resp.is_token:
                 _send_frame(sock, {

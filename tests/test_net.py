@@ -227,3 +227,58 @@ def test_dead_peer_raises_peer_unavailable():
                 cur_len=0, is_prefill=True, max_length=8))
     finally:
         reg.stop()
+
+
+def test_concurrent_sessions_through_stage_runtime():
+    """Two clients hammer one server whose compute runs through the
+    prioritized StageRuntime: both generations must match the single-client
+    oracle (one compute thread serializes donated-buffer steps)."""
+    import threading
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.task_pool import (
+        StageRuntime,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plan = StagePlan.from_splits(cfg.num_layers, [4])
+    reg = RegistryServer()
+    reg.start()
+    ex = StageExecutor(cfg, plan.stages[1],
+                       slice_stage_params(cfg, params, plan.stages[1]),
+                       peer_id="rt-srv")
+    srv = TcpStageServer(ex, wire_dtype="f32", runtime=StageRuntime())
+    srv.start()
+    rec = make_server_record("rt-srv", plan.stages[1])
+    rec.address = srv.address
+    reg.registry.register(rec)
+
+    sampling = SamplingParams(temperature=0.0)
+    prompts = [[5, 9, 23, 7], [11, 2, 30]]
+    expected = [oracle_generate(cfg, params, p, 5, sampling) for p in prompts]
+    results = [None, None]
+
+    def run(i):
+        registry = RemoteRegistry(reg.address)
+        transport = TcpTransport(registry, wire_dtype="f32")
+        stage0 = StageExecutor(cfg, plan.stages[0],
+                               slice_stage_params(cfg, params, plan.stages[0]),
+                               peer_id=f"client-{i}")
+        client = PipelineClient(cfg, plan, stage0, transport, registry,
+                                settle_seconds=0.0)
+        results[i] = client.generate(prompts[i], max_new_tokens=5,
+                                     sampling=sampling).tokens
+        transport.close()
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+        assert results[0] == expected[0]
+        assert results[1] == expected[1]
+        assert srv.runtime.tasks_done > 0
+    finally:
+        srv.stop()
+        reg.stop()

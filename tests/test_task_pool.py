@@ -1,0 +1,126 @@
+"""Prioritized task pools + stage runtime (the vendored Petals scheduling
+surface: petals/server/task_pool.py + task_prioritizer.py + the Runtime
+drain loop of server.py:557-671, re-homed in-process)."""
+
+import threading
+import time
+
+import pytest
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.task_pool import (
+    DummyTaskPrioritizer,
+    StageRuntime,
+    TaskRejected,
+)
+
+
+def test_inference_outranks_forward_and_backward():
+    """The DummyTaskPrioritizer policy: inference=1.0 beats fwd/bwd=2.0,
+    regardless of submission order."""
+    rt = StageRuntime()
+    order = []
+    rt.submit("backward", lambda: order.append("bwd1"))
+    rt.submit("forward", lambda: order.append("fwd1"))
+    rt.submit("inference", lambda: order.append("inf1"))
+    rt.submit("inference", lambda: order.append("inf2"))
+    while rt.run_once():
+        pass
+    assert order == ["inf1", "inf2", "bwd1", "fwd1"]
+
+
+def test_fifo_within_priority_level():
+    rt = StageRuntime()
+    order = []
+    for i in range(5):
+        rt.submit("inference", lambda i=i: order.append(i))
+    while rt.run_once():
+        pass
+    assert order == [0, 1, 2, 3, 4]
+
+
+def test_max_batch_size_guard():
+    """Oversized tasks are rejected at submission (task_pool.py:103-106)."""
+    rt = StageRuntime(max_batch_size=16)
+    with pytest.raises(TaskRejected):
+        rt.submit("inference", lambda: None, size=17)
+    fut = rt.submit("inference", lambda: "fits", size=16)
+    rt.run_once()
+    assert fut.result(0) == "fits"
+
+
+def test_future_carries_result_and_exception():
+    rt = StageRuntime()
+    ok = rt.submit("inference", lambda a, b: a + b, 2, 3)
+    bad = rt.submit("forward", lambda: 1 / 0)
+    while rt.run_once():
+        pass
+    assert ok.result(0) == 5
+    with pytest.raises(ZeroDivisionError):
+        bad.result(0)
+
+
+def test_custom_prioritizer_policy():
+    """The policy hook is pluggable (task_prioritizer.py:6-13): a policy that
+    inverts the default must reorder execution."""
+
+    class InferenceLast(DummyTaskPrioritizer):
+        def prioritize(self, kind, size, **kw):
+            return 0.5 if kind == "backward" else 5.0
+
+    rt = StageRuntime(prioritizer=InferenceLast())
+    order = []
+    rt.submit("inference", lambda: order.append("inf"))
+    rt.submit("backward", lambda: order.append("bwd"))
+    while rt.run_once():
+        pass
+    assert order == ["bwd", "inf"]
+
+
+def test_background_loop_executes_and_stop_fails_queued():
+    rt = StageRuntime()
+    rt.start()
+    try:
+        assert rt.call("inference", lambda: 42, timeout=5.0) == 42
+    finally:
+        rt.stop()
+    # queued-after-stop work is rejected, not silently dropped
+    with pytest.raises(TaskRejected):
+        rt.submit("inference", lambda: None)
+
+
+def test_stop_fails_inflight_queued_futures():
+    """A task queued behind a slow one when stop() lands must get an error,
+    not hang its waiter."""
+    rt = StageRuntime()
+    release = threading.Event()
+    rt.start()
+    slow = rt.submit("inference", release.wait, 5.0)
+    time.sleep(0.05)  # the loop is now blocked inside `slow`
+    stuck = rt.submit("inference", lambda: "never")
+    stopper = threading.Thread(target=rt.stop)
+    stopper.start()
+    time.sleep(0.05)  # stop() has raised the stop flag and is joining
+    release.set()
+
+    assert slow.result(5.0) is True
+    with pytest.raises(TaskRejected):
+        stuck.result(5.0)
+    stopper.join(5.0)
+    assert not stopper.is_alive()
+
+
+def test_single_thread_serializes_compute():
+    """All tasks run on the one runtime thread (the donation-safety property
+    the executor depends on)."""
+    rt = StageRuntime()
+    threads = set()
+    rt.start()
+    try:
+        futs = [rt.submit("inference",
+                          lambda: threads.add(threading.current_thread().name))
+                for _ in range(8)]
+        for f in futs:
+            f.result(5.0)
+    finally:
+        rt.stop()
+    assert len(threads) == 1
