@@ -211,11 +211,14 @@ def _attention(
     p: Params,
     x: jnp.ndarray,
     rope,
-    k_cache: jnp.ndarray,
-    v_cache: jnp.ndarray,
+    k_cache: Optional[jnp.ndarray],
+    v_cache: Optional[jnp.ndarray],
     cache_len: jnp.ndarray,
     tp_axis: Optional[str],
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
+    """k_cache=None selects the cache-free training path: causal attention of
+    the fresh keys over themselves (same math as a cache of length T at
+    position 0), nothing persisted."""
     b, t, _ = x.shape
     dh = cfg.head_dim
     q = x @ p["wq"]
@@ -234,10 +237,15 @@ def _attention(
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
-    k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, cache_len)
-    out = cached_attention(
-        q, k_cache, v_cache, cache_len, sliding_window=cfg.sliding_window
-    )
+    if k_cache is None:
+        out = cached_attention(
+            q, k, v, jnp.int32(0), sliding_window=cfg.sliding_window
+        )
+    else:
+        k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, cache_len)
+        out = cached_attention(
+            q, k_cache, v_cache, cache_len, sliding_window=cfg.sliding_window
+        )
     y = out.reshape(b, t, h_local * dh) @ p["wo"]
     y = _psum_if(y, tp_axis)
     if "bo" in p:
@@ -256,14 +264,15 @@ def layer_forward(
     p: Params,
     x: jnp.ndarray,
     rope,
-    k_cache: jnp.ndarray,
-    v_cache: jnp.ndarray,
+    k_cache: Optional[jnp.ndarray],
+    v_cache: Optional[jnp.ndarray],
     cache_len: jnp.ndarray,
     tp_axis: Optional[str] = None,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     """Pre-norm residual block. x: [B,T,D] -> ([B,T,D], new k/v cache).
 
     rope: (cos, sin) from `make_rope`, or None for learned-position models.
+    k_cache=None selects the cache-free training path (see `_attention`).
     """
     attn_out, k_cache, v_cache = _attention(
         cfg, p["attn"], _norm(cfg, p["ln1"], x), rope, k_cache, v_cache,
@@ -297,6 +306,44 @@ def stack_forward(
 
     x, (k_caches, v_caches) = jax.lax.scan(body, x, (layers, k_caches, v_caches))
     return x, k_caches, v_caches
+
+
+def layer_forward_train(
+    cfg: ModelConfig,
+    p: Params,
+    x: jnp.ndarray,
+    rope,
+    tp_axis: Optional[str] = None,
+) -> jnp.ndarray:
+    """Cache-free pre-norm block for the training path (full-sequence causal
+    attention, nothing persisted). Counterpart of the vendored backward path's
+    re-forward (reference ``petals/server/block_functions.py:106-124``)."""
+    x, _, _ = layer_forward(cfg, p, x, rope, None, None, jnp.int32(0), tp_axis)
+    return x
+
+
+def stack_forward_train(
+    cfg: ModelConfig,
+    layers: Params,
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    tp_axis: Optional[str] = None,
+    remat: bool = True,
+) -> jnp.ndarray:
+    """Training forward of a span of stacked layers (lax.scan, no KV cache).
+
+    remat=True checkpoints each layer — reverse-mode AD recomputes the layer
+    forward instead of saving every intermediate (HBM for FLOPs, the standard
+    TPU training trade)."""
+    rope = make_rope(cfg, positions)
+
+    def body(h, lp):
+        return layer_forward_train(cfg, lp, h, rope, tp_axis), None
+
+    if remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, layers)
+    return x
 
 
 def lm_head(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,271 @@
+"""Pipelined distributed TRAINING step: loss + grads + AdamW in one program.
+
+The reference's training surface is the vendored fine-tuning path — never
+runnable there: ``rpc_backward`` re-forwards a span and returns input grads
+(``petals/server/handler.py:434-488``, ``petals/server/block_functions.py:
+84-141``). The TPU-native version doesn't shuttle gradients over RPC at all:
+forward AND backward both ride ICI inside one jitted program. The GPipe-style
+tick loop (same schedule as `parallel.pipeline.IciPipeline`) is written with
+``lax.scan`` so reverse-mode AD differentiates straight through it —
+``ppermute``'s transpose is the reversed permute, so XLA derives the backward
+pipeline schedule mechanically instead of us hand-coding a second tick loop.
+
+Trainable tree layout matches `IciPipeline`: stacked layers [S, L/S, ...]
+sharded on ("stage"[, "tp"]); embed / final_norm / lm_head replicated (tied
+embeddings share one leaf, so the tying gradient is exact). The optimizer is
+an inline AdamW whose moment trees inherit the parameter shardings — optimizer
+state never leaves the device that owns the weight shard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..models.transformer import embed_tokens, lm_head, stack_forward_train
+from .pipeline import (
+    _pipeline_layer_specs,
+    make_pipeline_mesh,
+    stack_pipeline_params,
+)
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Inline AdamW (moment trees shard like params; no opaque optimizer state)
+# ---------------------------------------------------------------------------
+
+def adamw_init(params: Params) -> Params:
+    zeros = lambda t: jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), t)
+    return {"mu": zeros(params), "nu": zeros(params),
+            "count": jnp.zeros((), jnp.int32)}
+
+
+def adamw_update(
+    grads: Params, state: Params, params: Params, *,
+    lr: float = 1e-4, b1: float = 0.9, b2: float = 0.999,
+    eps: float = 1e-8, weight_decay: float = 0.0,
+) -> Tuple[Params, Params]:
+    count = state["count"] + 1
+    c1 = 1.0 - jnp.power(b1, count.astype(jnp.float32))
+    c2 = 1.0 - jnp.power(b2, count.astype(jnp.float32))
+    g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+    mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state["mu"], g32)
+    nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, state["nu"], g32)
+
+    def upd(p, m, v):
+        step = (m / c1) / (jnp.sqrt(v / c2) + eps)
+        return (p.astype(jnp.float32) - lr * (step + weight_decay *
+                p.astype(jnp.float32))).astype(p.dtype)
+
+    params = jax.tree.map(upd, params, mu, nu)
+    return params, {"mu": mu, "nu": nu, "count": count}
+
+
+# ---------------------------------------------------------------------------
+# Pipelined training forward (tick loop, differentiable)
+# ---------------------------------------------------------------------------
+
+def _train_body(cfg: ModelConfig, num_stages: int, num_micro: int,
+                tp_axis: Optional[str]):
+    """shard_map body: layers [1, L/S, ...] per stage device; stream
+    [M, B, T, D] replicated; positions [B, T] replicated. Returns the last
+    stage's outputs [M, B, T, D], psum-replicated."""
+
+    def body(layers, stream, positions):
+        layers = jax.tree.map(lambda x: x[0], layers)
+        s = jax.lax.axis_index("stage")
+        is_last = s == num_stages - 1
+        m, b, t, d = stream.shape
+        perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+        def tick(carry, ti):
+            received, outs = carry
+            mb = ti - s
+            valid = (mb >= 0) & (mb < num_micro)
+            mbc = jnp.clip(mb, 0, num_micro - 1)
+            x_in = jnp.where(
+                s == 0,
+                jax.lax.dynamic_index_in_dim(stream, mbc, 0, keepdims=False),
+                received,
+            )
+            out = stack_forward_train(cfg, layers, x_in, positions,
+                                      tp_axis=tp_axis, remat=True)
+            outs = jnp.where(
+                is_last & valid,
+                jax.lax.dynamic_update_index_in_dim(outs, out, mbc, 0),
+                outs,
+            )
+            received = jax.lax.ppermute(out, "stage", perm)
+            return (received, outs), None
+
+        received = jax.lax.pcast(
+            jnp.zeros((b, t, d), stream.dtype), ("stage",), to="varying"
+        )
+        outs = jax.lax.pcast(
+            jnp.zeros((m, b, t, d), stream.dtype), ("stage",), to="varying"
+        )
+        (received, outs), _ = jax.lax.scan(
+            tick, (received, outs),
+            jnp.arange(num_micro + num_stages - 1, dtype=jnp.int32),
+        )
+        outs = jax.lax.psum(
+            jnp.where(is_last, outs, jnp.zeros_like(outs)), "stage"
+        )
+        return outs
+
+    return body
+
+
+def softmax_xent(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Mean cross-entropy over positions with target >= 0 (< 0 = ignore)."""
+    mask = (targets >= 0).astype(jnp.float32)
+    tgt = jnp.clip(targets, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def single_device_loss(cfg: ModelConfig, params: Params, ids: jnp.ndarray,
+                       targets: jnp.ndarray) -> jnp.ndarray:
+    """Unpartitioned training loss over [M, B, T] microbatches — the oracle
+    the pipelined loss (and its grads) must match (same role as reference
+    ``scripts/single_gpu_check.py`` for inference)."""
+    m, b, t = ids.shape
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
+
+    def one(i):
+        x = embed_tokens(cfg, params["embed"], i, positions)
+        x = stack_forward_train(cfg, params["layers"], x, positions, remat=False)
+        return lm_head(cfg, params, x)
+
+    logits = jax.vmap(one)(ids)
+    return softmax_xent(logits, targets)
+
+
+@dataclasses.dataclass
+class PipelineTrainer:
+    """Compiled fused-pipeline trainer.
+
+    Usage::
+
+        tr = PipelineTrainer.build(cfg, params, num_stages=4, num_micro=2)
+        loss = tr.step(ids, targets)     # ids/targets: [M, B, T] int32
+    """
+
+    cfg: ModelConfig
+    mesh: Mesh
+    num_stages: int
+    num_micro: int
+    tp: int
+    trainables: Params          # embed/final_norm(/lm_head) repl + layers [S,L/S]
+    opt_state: Params
+    lr: float
+    _step: Any
+    last_loss: Optional[float] = None
+
+    @staticmethod
+    def build(
+        cfg: ModelConfig,
+        params: Params,
+        num_stages: int,
+        num_micro: int = 1,
+        mesh: Optional[Mesh] = None,
+        tp: int = 1,
+        lr: float = 1e-4,
+        weight_decay: float = 0.0,
+    ) -> "PipelineTrainer":
+        if tp > 1:
+            from .tensor_parallel import validate_tp
+
+            validate_tp(cfg, tp)
+        mesh = mesh or make_pipeline_mesh(num_stages, tp=tp)
+        if mesh.shape.get("stage") != num_stages or mesh.shape.get("tp", 1) != tp:
+            raise ValueError(
+                f"mesh axes {dict(mesh.shape)} do not match num_stages="
+                f"{num_stages}, tp={tp}"
+            )
+        layers = stack_pipeline_params(params, num_stages)
+        layer_specs = _pipeline_layer_specs(cfg, layers, tp)
+        repl = NamedSharding(mesh, P())
+        # step() donates these buffers, so they must be OWNED copies: on the
+        # CPU platform device_put's replicated shard aliases the source buffer
+        # even with may_alias=False, and donating it would delete the caller's
+        # params (e.g. when the same checkpoint also feeds an IciPipeline).
+        # jnp.copy breaks the alias chain before resharding.
+        def put(tree, sh_or_tree):
+            if not isinstance(sh_or_tree, NamedSharding):
+                return jax.tree.map(
+                    lambda x, sp: jax.device_put(
+                        jnp.copy(x), NamedSharding(mesh, sp)),
+                    tree, sh_or_tree,
+                )
+            return jax.tree.map(
+                lambda x: jax.device_put(jnp.copy(x), sh_or_tree), tree
+            )
+        trainables: Params = {
+            "embed": put(params["embed"], repl),
+            "layers_stacked": put(layers, layer_specs),
+            "final_norm": put(params["final_norm"], repl),
+        }
+        if not cfg.tie_word_embeddings:
+            trainables["lm_head"] = put(params["lm_head"], repl)
+        # Moment trees inherit param shardings leaf-for-leaf.
+        opt_state = jax.jit(adamw_init)(trainables)
+
+        tp_axis = "tp" if tp > 1 else None
+        body = _train_body(cfg, num_stages, num_micro, tp_axis)
+
+        def loss_fn(tr: Params, ids, targets):
+            m, b, t = ids.shape
+            positions = jnp.broadcast_to(
+                jnp.arange(t, dtype=jnp.int32)[None, :], (b, t)
+            )
+            x = jax.vmap(
+                lambda i: embed_tokens(cfg, tr["embed"], i, positions)
+            )(ids)
+            sharded = shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(layer_specs, P(), P()),
+                out_specs=P(),
+            )
+            outs = sharded(tr["layers_stacked"], x, positions)
+            logits = jax.vmap(lambda h: lm_head(cfg, tr, h))(outs)
+            return softmax_xent(logits, targets)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(tr, opt_state, ids, targets):
+            loss, grads = jax.value_and_grad(loss_fn)(tr, ids, targets)
+            tr, opt_state = adamw_update(
+                grads, opt_state, tr, lr=lr, weight_decay=weight_decay
+            )
+            return loss, tr, opt_state
+
+        return PipelineTrainer(
+            cfg=cfg, mesh=mesh, num_stages=num_stages, num_micro=num_micro,
+            tp=tp, trainables=trainables, opt_state=opt_state, lr=lr,
+            _step=step,
+        )
+
+    def step(self, ids: jnp.ndarray, targets: jnp.ndarray) -> float:
+        """One fused train step over [M, B, T] token ids / shifted targets.
+        Updates trainables/opt_state in place (donated buffers)."""
+        if ids.shape[0] != self.num_micro:
+            raise ValueError(
+                f"ids has {ids.shape[0]} microbatches, trainer compiled for "
+                f"{self.num_micro}"
+            )
+        loss, self.trainables, self.opt_state = self._step(
+            self.trainables, self.opt_state, ids, targets
+        )
+        self.last_loss = float(loss)
+        return self.last_loss

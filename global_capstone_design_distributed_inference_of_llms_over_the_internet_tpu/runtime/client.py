@@ -120,6 +120,12 @@ class PipelineClient:
         self.journal: Dict[str, Dict[str, List[JournalEntry]]] = {}
         # hop key -> peers that failed for that hop (src/rpc_transport.py:107-108)
         self.failed_peers: Dict[str, set] = {}
+        # session -> every peer that ever held KV for it. A timed-out peer
+        # the client failed over AWAY from is usually still alive and still
+        # holding the session's arena lease; _end_session must release it
+        # there too or each failover permanently shrinks that server's
+        # advertised cache capacity.
+        self._session_peers: Dict[str, set] = {}
         self._route: Optional[List[Hop]] = None
 
         # Metrics mirroring RpcTransport.last_prefill_stage_times /
@@ -227,7 +233,9 @@ class PipelineClient:
     def _call_with_recovery(self, hop: Hop, req: StageRequest) -> StageResponse:
         """3-attempt failover (``src/rpc_transport.py:587-668``)."""
         last_exc: Optional[Exception] = None
+        touched = self._session_peers.setdefault(req.session_id, set())
         for attempt in range(MAX_ATTEMPTS):
+            touched.add(hop.peer_id)
             try:
                 return self.transport.call(hop.peer_id, req, timeout=self.request_timeout)
             # Retryable taxonomy: connectivity faults + server-side session
@@ -418,14 +426,17 @@ class PipelineClient:
 
     def _end_session(self, session_id: str) -> None:
         self.stage0.drop_session(session_id)
-        # Release the KV lease on every remote hop (best-effort) — without
-        # this, each generation permanently consumes remote arena budget.
+        # Release the KV lease on every peer that ever held it (best-effort):
+        # current route hops PLUS peers abandoned by failover — without this,
+        # each generation (or failover) permanently consumes arena budget.
+        peers = set(self._session_peers.pop(session_id, ()))
         if self._route:
-            for hop in self._route:
-                try:
-                    self.transport.end_session(hop.peer_id, session_id)
-                except Exception:  # a dead peer's lease dies with the peer
-                    pass
+            peers.update(hop.peer_id for hop in self._route)
+        for peer_id in peers:
+            try:
+                self.transport.end_session(peer_id, session_id)
+            except Exception:  # a dead peer's lease dies with the peer
+                pass
         for sessions in self.journal.values():
             sessions.pop(session_id, None)
 

@@ -330,7 +330,17 @@ class TcpStageServer(_FramedTcpServer):
                     "cache_len": resp.cache_len, "tensor": meta,
                 }, body)
         elif verb == "end_session":
-            self.executor.drop_session(header["session_id"])
+            # Through the runtime's compute thread, NOT inline: freeing the
+            # arena handle while a timed-out forward for the same session is
+            # still stepping its KV buffers would null them mid-step and
+            # corrupt the arena's byte accounting.
+            try:
+                self._compute("inference", self.executor.drop_session,
+                              header["session_id"])
+            except (StageExecutionError, TaskRejected, TimeoutError) as exc:
+                _send_frame(sock, {"verb": "error", "message": str(exc),
+                                   "kind": "stage"})
+                return
             _send_frame(sock, {"verb": "ok"})
         elif verb == "info":
             spec = self.executor.spec

@@ -1,0 +1,112 @@
+"""Pipelined training step vs single-device oracle on the virtual CPU mesh.
+
+The reference's training path (vendored ``rpc_backward``,
+``petals/server/handler.py:434-488``) was never runnable; here the full
+loss/grad/AdamW step is jitted over the ("stage"[, "tp"]) mesh and must match
+the unpartitioned loss + gradients exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+    gpt2_config,
+    init_params,
+    llama_config,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.parallel.trainer import (
+    PipelineTrainer,
+    single_device_loss,
+    softmax_xent,
+)
+
+
+def tiny_cfg():
+    return llama_config(vocab_size=251, hidden_size=64, num_layers=8,
+                        num_heads=4, num_kv_heads=2, intermediate_size=128,
+                        max_position_embeddings=64)
+
+
+def make_batch(cfg, m, b, t, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, cfg.vocab_size, size=(m, b, t)).astype(np.int32)
+    # next-token targets with the final position masked out
+    targets = np.concatenate(
+        [ids[..., 1:], np.full((m, b, 1), -1, np.int32)], axis=-1
+    )
+    return jnp.asarray(ids), jnp.asarray(targets)
+
+
+@pytest.mark.parametrize("num_stages,num_micro,tp", [(4, 2, 1), (2, 1, 2), (8, 2, 1)])
+def test_pipeline_loss_matches_oracle(num_stages, num_micro, tp):
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ids, targets = make_batch(cfg, num_micro, 2, 16)
+
+    oracle = float(single_device_loss(cfg, params, ids, targets))
+
+    mesh_devs = jax.devices()[: num_stages * tp]
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.parallel.pipeline import (
+        make_pipeline_mesh,
+    )
+
+    mesh = make_pipeline_mesh(num_stages, mesh_devs, tp=tp)
+    tr = PipelineTrainer.build(cfg, params, num_stages=num_stages,
+                               num_micro=num_micro, mesh=mesh, tp=tp, lr=0.0)
+    loss = tr.step(ids, targets)
+    np.testing.assert_allclose(loss, oracle, rtol=2e-4)
+
+
+def test_pipeline_grads_match_oracle():
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    num_stages, num_micro = 4, 2
+    ids, targets = make_batch(cfg, num_micro, 1, 12, seed=3)
+
+    # Oracle grads w.r.t. a replicated scalar knob: scale every layer weight.
+    # Comparing full grad trees across the stacked [S, L/S] layout is fiddly;
+    # instead compare d(loss)/d(embed wte) — it feeds every stage (stage-0
+    # input AND tied/untied head) so any backward-schedule bug corrupts it.
+    def oracle_loss(wte):
+        p2 = dict(params)
+        p2["embed"] = dict(params["embed"], wte=wte)
+        return single_device_loss(cfg, p2, ids, targets)
+
+    g_oracle = jax.grad(oracle_loss)(params["embed"]["wte"])
+
+    tr = PipelineTrainer.build(cfg, params, num_stages=num_stages,
+                               num_micro=num_micro, lr=0.0)
+
+    # lr=0: step() computes grads but leaves params unchanged; recover the
+    # embed grad from the AdamW first-moment buffer (mu = (1-b1)*g after one
+    # step from zero init).
+    tr.step(ids, targets)
+    mu = tr.opt_state["mu"]["embed"]["wte"]
+    g_pipe = np.asarray(mu) / 0.1  # (1 - b1) with b1=0.9
+    np.testing.assert_allclose(
+        g_pipe, np.asarray(g_oracle), rtol=2e-3, atol=2e-5
+    )
+
+
+def test_training_reduces_loss():
+    cfg = gpt2_config(vocab_size=128, hidden_size=32, num_layers=4,
+                      num_heads=4, intermediate_size=64,
+                      max_position_embeddings=32)
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    ids, targets = make_batch(cfg, 2, 2, 16, seed=7)
+    tr = PipelineTrainer.build(cfg, params, num_stages=2, num_micro=2, lr=3e-3)
+    first = tr.step(ids, targets)
+    for _ in range(10):
+        last = tr.step(ids, targets)
+    assert last < first * 0.8, (first, last)
+
+
+def test_softmax_xent_ignores_masked():
+    logits = jnp.zeros((1, 1, 4, 8))
+    targets = jnp.array([[[1, 2, -1, -1]]], dtype=jnp.int32)
+    # uniform logits -> loss = log(8) over the 2 valid positions
+    np.testing.assert_allclose(
+        float(softmax_xent(logits, targets)), float(np.log(8.0)), rtol=1e-6
+    )
