@@ -329,20 +329,42 @@ def stack_forward_train(
     positions: jnp.ndarray,
     tp_axis: Optional[str] = None,
     remat: bool = True,
+    prompts: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Training forward of a span of stacked layers (lax.scan, no KV cache).
 
     remat=True checkpoints each layer — reverse-mode AD recomputes the layer
     forward instead of saving every intermediate (HBM for FLOPs, the standard
-    TPU training trade)."""
+    TPU training trade).
+
+    prompts: optional [L, pre_seq, D] deep-prompt-tuning tensors, ADDED into
+    the first pre_seq positions of each layer's input (the vendored semantics,
+    ``petals/server/block_functions.py:57-65``)."""
     rope = make_rope(cfg, positions)
 
-    def body(h, lp):
+    if prompts is None:
+        def body(h, lp):
+            return layer_forward_train(cfg, lp, h, rope, tp_axis), None
+
+        if remat:
+            body = jax.checkpoint(body)
+        x, _ = jax.lax.scan(body, x, layers)
+        return x
+
+    # Clamp to the sequence length (static at trace time): a batch shorter
+    # than pre_seq simply uses the prompts' first T rows; the unused tail gets
+    # zero gradients, so client-local and (bucket-padded) server spans agree.
+    pre = min(prompts.shape[1], x.shape[1])
+
+    def body_p(h, xs):
+        lp, pr = xs
+        patch = jax.lax.dynamic_slice_in_dim(h, 0, pre, axis=1) + pr[None, :pre]
+        h = jax.lax.dynamic_update_slice_in_dim(h, patch.astype(h.dtype), 0, axis=1)
         return layer_forward_train(cfg, lp, h, rope, tp_axis), None
 
     if remat:
-        body = jax.checkpoint(body)
-    x, _ = jax.lax.scan(body, x, layers)
+        body_p = jax.checkpoint(body_p)
+    x, _ = jax.lax.scan(body_p, x, (layers, prompts))
     return x
 
 

@@ -44,8 +44,14 @@ from ..models.partition import (
     stage_forward,
 )
 from ..ops.sampling import RECENT_WINDOW, sample_token
+from ..models.transformer import stack_forward_train
 from .kv_cache import KVArena, KVHandle, round_to_bucket
-from .messages import StageRequest, StageResponse
+from .messages import (
+    BackwardRequest,
+    BackwardResponse,
+    StageRequest,
+    StageResponse,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -277,6 +283,105 @@ class StageExecutor:
             jnp.asarray(sp.repetition_penalty, jnp.float32),
         )
         return int(token)
+
+    # ------------------------------------------------------------------
+    # Fine-tuning path (vendored rpc_forward/rpc_backward training surface,
+    # petals/server/handler.py:352-488, block_functions.py:32-141)
+    # ------------------------------------------------------------------
+
+    def _train_fns(self, a: int, b: int):
+        """Jitted (forward, backward) for blocks [a, b) of the loaded span.
+        Stateless: no KV, no session; frozen span weights; grads flow to
+        inputs (+ prompts — jit re-specializes per prompts shape/None)."""
+        key = ("train", a, b)
+        entry = self._subspans.get(key)
+        if entry is not None:
+            return entry
+        cfg = self.cfg
+        if a == 0 and b == self.spec.num_layers:
+            layers = self.params["layers"]  # no duplicate HBM copy
+        else:
+            layers = jax.tree.map(lambda x: x[a:b], self.params["layers"])
+
+        def f(x, prompts):
+            bsz, t, _ = x.shape
+            positions = jnp.broadcast_to(
+                jnp.arange(t, dtype=jnp.int32)[None, :], (bsz, t)
+            )
+            return stack_forward_train(cfg, layers, x, positions,
+                                       prompts=prompts)
+
+        fwd = jax.jit(f)
+
+        @jax.jit
+        def bwd(x, prompts, grad_out):
+            _, vjp = jax.vjp(f, x, prompts)
+            return vjp(grad_out.astype(x.dtype))
+
+        entry = (fwd, bwd)
+        self._subspans[key] = entry
+        return entry
+
+    def _train_args(self, req) -> tuple:
+        """Shared validation/padding for train_forward and backward."""
+        a, b = self._resolve_range(req)
+        x = jnp.asarray(req.hidden)
+        if x.ndim != 3:
+            raise StageExecutionError(
+                f"training forward expects hidden [B, T, D], got {x.shape}"
+            )
+        if x.shape[1] != req.seq_len:
+            raise StageExecutionError(
+                f"seq_len {req.seq_len} != tensor T {x.shape[1]}"
+            )
+        prompts = None if req.prompts is None else jnp.asarray(req.prompts)
+        if prompts is not None and prompts.shape[0] != b - a:
+            raise StageExecutionError(
+                f"prompts cover {prompts.shape[0]} layers, request spans {b - a}"
+            )
+        return a, b, x, prompts
+
+    def train_forward(self, req: StageRequest) -> StageResponse:
+        """Cache-free span forward of the BLOCKS only (no head/sampling) —
+        the training rpc_forward. Sequence padded to the shared buckets so an
+        epoch of varying lengths stays within a handful of compiles."""
+        a, b, x, prompts = self._train_args(req)
+        fwd, _ = self._train_fns(a, b)
+        t_real = req.seq_len
+        tb = round_to_bucket(t_real, SEQ_BUCKETS)
+        if tb != t_real:
+            x = jnp.pad(x, ((0, 0), (0, tb - t_real), (0, 0)))
+        out = fwd(x, prompts)
+        self.requests_served += 1
+        return StageResponse(
+            session_id=req.session_id, hidden=out[:, :t_real], cache_len=0
+        )
+
+    def backward(self, req: BackwardRequest) -> BackwardResponse:
+        """Re-forward blocks [a, b) from the supplied input and return
+        (grad_input, grad_prompts). Activations are recomputed, never stored
+        between training RPCs — same contract as the reference's
+        ``run_rpc_backward`` re-forward (block_functions.py:106-124)."""
+        a, b, x, prompts = self._train_args(req)
+        g = jnp.asarray(req.grad_output)
+        if g.shape != x.shape:
+            raise StageExecutionError(
+                f"grad_output shape {g.shape} != hidden shape {x.shape}"
+            )
+        _, bwd = self._train_fns(a, b)
+        t_real = req.seq_len
+        tb = round_to_bucket(t_real, SEQ_BUCKETS)
+        if tb != t_real:
+            pad = ((0, 0), (0, tb - t_real), (0, 0))
+            x = jnp.pad(x, pad)
+            g = jnp.pad(g, pad)  # zero cotangents on padding
+        gx, gp = bwd(x, prompts, g)
+        self.requests_served += 1
+        return BackwardResponse(
+            session_id=req.session_id,
+            grad_input=gx[:, :t_real],
+            grad_prompts=gp,
+        )
 
     # ------------------------------------------------------------------
     # Lifecycle

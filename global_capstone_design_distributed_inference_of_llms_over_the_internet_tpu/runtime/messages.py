@@ -47,6 +47,34 @@ class StageRequest:
     # assigned it, not everything it has loaded.
     start_block: Optional[int] = None
     end_block: Optional[int] = None
+    # Fine-tuning forward (the vendored ``rpc_forward`` training path,
+    # ``petals/server/block_functions.py:32-81``): stateless cache-free span
+    # forward of the BLOCKS only (no head/sampling), with optional deep
+    # prompts added into the first positions of each block's input.
+    train: bool = False
+    prompts: Optional[jnp.ndarray] = None   # [span_layers, pre_seq, D]
+
+
+@dataclasses.dataclass
+class BackwardRequest:
+    """``rpc_backward`` (``petals/server/handler.py:434-488``): the server
+    re-forwards its span from the supplied input (activations are NOT stored
+    server-side between training steps) and returns input/prompt grads."""
+
+    session_id: str
+    hidden: jnp.ndarray            # [B, T, D] span INPUT (what forward consumed)
+    grad_output: jnp.ndarray       # [B, T, D] dL/d(span output)
+    seq_len: int                   # REAL tokens in hidden/grad_output
+    prompts: Optional[jnp.ndarray] = None   # [span_layers, pre_seq, D]
+    start_block: Optional[int] = None
+    end_block: Optional[int] = None
+
+
+@dataclasses.dataclass
+class BackwardResponse:
+    session_id: str
+    grad_input: jnp.ndarray                   # [B, T, D]
+    grad_prompts: Optional[jnp.ndarray] = None  # [span_layers, pre_seq, D]
 
 
 @dataclasses.dataclass

@@ -47,7 +47,7 @@ from .. import native
 from ..ops.sampling import SamplingParams
 from ..scheduling.registry import PlacementRegistry, ServerRecord
 from .executor import StageExecutionError, StageExecutor
-from .messages import StageRequest, StageResponse
+from .messages import BackwardRequest, StageRequest, StageResponse
 from .task_pool import StageRuntime, TaskRejected
 from .transport import PeerUnavailable, Transport
 
@@ -121,6 +121,26 @@ def _decode_tensor(meta: dict, payload: bytes) -> np.ndarray:
     if meta["dtype"] == "bf16":
         return native.bf16_bytes_to_fp32(payload, shape)
     return np.frombuffer(payload, np.float32).reshape(shape).copy()
+
+
+def _encode_tensors(arrs, wire_dtype: str) -> Tuple[list, bytes]:
+    """Pack several tensors into one payload; each meta gains 'nbytes'."""
+    metas, chunks = [], []
+    for arr in arrs:
+        meta, body = _encode_tensor(np.asarray(arr), wire_dtype)
+        meta["nbytes"] = len(body)
+        metas.append(meta)
+        chunks.append(body)
+    return metas, b"".join(chunks)
+
+
+def _decode_tensors(metas: list, payload: bytes) -> list:
+    out, off = [], 0
+    for meta in metas:
+        n = meta["nbytes"]
+        out.append(_decode_tensor(meta, payload[off:off + n]))
+        off += n
+    return out
 
 
 def _request_header(req: StageRequest, tensor_meta: dict) -> dict:
@@ -329,6 +349,58 @@ class TcpStageServer(_FramedTcpServer):
                     "verb": "hidden", "session_id": resp.session_id,
                     "cache_len": resp.cache_len, "tensor": meta,
                 }, body)
+        elif verb in ("train_forward", "backward"):
+            # QoS via the pool kinds: inference outranks both training verbs
+            # (DummyTaskPrioritizer semantics, petals/server/task_prioritizer.py).
+            tensors = _decode_tensors(header["tensors"], payload)
+            try:
+                if verb == "train_forward":
+                    req = StageRequest(
+                        session_id=header["session_id"],
+                        hidden=jnp.asarray(tensors[0]),
+                        seq_len=header["seq_len"], cur_len=0, is_prefill=False,
+                        max_length=0, train=True,
+                        prompts=(jnp.asarray(tensors[1])
+                                 if len(tensors) > 1 else None),
+                        start_block=header.get("start_block"),
+                        end_block=header.get("end_block"),
+                    )
+                    resp = self._compute("forward", self.executor.train_forward,
+                                         req, size=req.seq_len)
+                    arr = np.asarray(resp.hidden)
+                    meta, body = _encode_tensor(arr, self.wire_dtype)
+                    _send_frame(sock, {
+                        "verb": "hidden", "session_id": resp.session_id,
+                        "cache_len": 0, "tensor": meta,
+                    }, body)
+                else:
+                    breq = BackwardRequest(
+                        session_id=header["session_id"],
+                        hidden=jnp.asarray(tensors[0]),
+                        grad_output=jnp.asarray(tensors[1]),
+                        seq_len=header["seq_len"],
+                        prompts=(jnp.asarray(tensors[2])
+                                 if len(tensors) > 2 else None),
+                        start_block=header.get("start_block"),
+                        end_block=header.get("end_block"),
+                    )
+                    bresp = self._compute("backward", self.executor.backward,
+                                          breq, size=breq.seq_len)
+                    arrs = [np.asarray(bresp.grad_input)]
+                    if bresp.grad_prompts is not None:
+                        arrs.append(np.asarray(bresp.grad_prompts))
+                    metas, body = _encode_tensors(arrs, "f32")
+                    _send_frame(sock, {
+                        "verb": "grads", "session_id": bresp.session_id,
+                        "tensors": metas,
+                    }, body)
+            except (StageExecutionError, TaskRejected) as exc:
+                _send_frame(sock, {"verb": "error", "message": str(exc),
+                                   "kind": "stage"})
+            except TimeoutError:
+                _send_frame(sock, {"verb": "error", "kind": "stage",
+                                   "message": f"stage compute timed out after "
+                                              f"{self.compute_timeout:.0f}s"})
         elif verb == "end_session":
             # Through the runtime's compute thread, NOT inline: freeing the
             # arena handle while a timed-out forward for the same session is
@@ -415,9 +487,24 @@ class TcpTransport(Transport):
         sock = self._connect(peer_id)
         try:
             sock.settimeout(timeout)
-            arr = np.asarray(request.hidden)
-            meta, body = _encode_tensor(arr, self.wire_dtype)
-            _send_frame(sock, _request_header(request, meta), body)
+            if request.train:
+                arrs = [np.asarray(request.hidden)]
+                if request.prompts is not None:
+                    arrs.append(np.asarray(request.prompts))
+                metas, body = _encode_tensors(arrs, self.wire_dtype)
+                hdr = {
+                    "verb": "train_forward",
+                    "session_id": request.session_id,
+                    "seq_len": request.seq_len,
+                    "start_block": request.start_block,
+                    "end_block": request.end_block,
+                    "tensors": metas,
+                }
+                _send_frame(sock, hdr, body)
+            else:
+                arr = np.asarray(request.hidden)
+                meta, body = _encode_tensor(arr, self.wire_dtype)
+                _send_frame(sock, _request_header(request, meta), body)
             header, payload = _recv_frame(sock)
         except socket.timeout as exc:
             self._drop(peer_id)
@@ -442,6 +529,50 @@ class TcpTransport(Transport):
                 raise StageExecutionError(header.get("message", "stage error"))
             raise RuntimeError(f"peer {peer_id} error: {header.get('message')}")
         raise WireError(f"unexpected response verb {verb!r}")
+
+    def backward(self, peer_id: str, request: "BackwardRequest",
+                 timeout: Optional[float] = None) -> "BackwardResponse":
+        from .messages import BackwardResponse
+
+        sock = self._connect(peer_id)
+        try:
+            sock.settimeout(timeout)
+            # Gradients ride the wire fp32: bf16's 8 mantissa bits compound
+            # across hops (the reference compresses activations, never grads —
+            # petals/server/handler.py:496-520 uses the schema dtype).
+            arrs = [np.asarray(request.hidden), np.asarray(request.grad_output)]
+            if request.prompts is not None:
+                arrs.append(np.asarray(request.prompts))
+            metas, body = _encode_tensors(arrs, "f32")
+            hdr = {
+                "verb": "backward",
+                "session_id": request.session_id,
+                "seq_len": request.seq_len,
+                "start_block": request.start_block,
+                "end_block": request.end_block,
+                "tensors": metas,
+            }
+            _send_frame(sock, hdr, body)
+            header, payload = _recv_frame(sock)
+        except socket.timeout as exc:
+            self._drop(peer_id)
+            raise TimeoutError(f"peer {peer_id} timed out") from exc
+        except (ConnectionError, OSError) as exc:
+            self._drop(peer_id)
+            raise PeerUnavailable(f"peer {peer_id} connection failed: {exc}")
+        if header.get("verb") == "grads":
+            tensors = _decode_tensors(header["tensors"], payload)
+            return BackwardResponse(
+                session_id=header["session_id"],
+                grad_input=jnp.asarray(tensors[0]),
+                grad_prompts=(jnp.asarray(tensors[1])
+                              if len(tensors) > 1 else None),
+            )
+        if header.get("verb") == "error":
+            if header.get("kind") == "stage":
+                raise StageExecutionError(header.get("message", "stage error"))
+            raise RuntimeError(f"peer {peer_id} error: {header.get('message')}")
+        raise WireError(f"unexpected response verb {header.get('verb')!r}")
 
     def end_session(self, peer_id: str, session_id: str) -> None:
         try:

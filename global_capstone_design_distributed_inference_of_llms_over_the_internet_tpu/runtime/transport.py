@@ -31,7 +31,12 @@ import time
 from typing import Callable, Dict, Optional
 
 from .executor import StageExecutor
-from .messages import StageRequest, StageResponse
+from .messages import (
+    BackwardRequest,
+    BackwardResponse,
+    StageRequest,
+    StageResponse,
+)
 
 
 class PeerUnavailable(ConnectionError):
@@ -49,6 +54,14 @@ class Transport(abc.ABC):
     @abc.abstractmethod
     def alive(self, peer_id: str) -> bool:
         ...
+
+    def backward(self, peer_id: str, request: BackwardRequest,
+                 timeout: Optional[float] = None) -> BackwardResponse:
+        """Fine-tuning backward hop (``rpc_backward``). Optional: transports
+        that only serve inference may leave this unimplemented."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support the training path"
+        )
 
     def end_session(self, peer_id: str, session_id: str) -> None:
         """Best-effort: release the session's KV lease on a peer. The reference
@@ -151,4 +164,16 @@ class LocalTransport(Transport):
                     f"peer {peer_id} timed out after {timeout:.1f}s (stalled)"
                 )
             time.sleep(stall)
+        if request.train:
+            return executor.train_forward(request)
         return executor.forward(request)
+
+    def backward(self, peer_id: str, request: BackwardRequest,
+                 timeout: Optional[float] = None) -> BackwardResponse:
+        with self._lock:
+            self.calls += 1
+            executor = self._peers.get(peer_id)
+            dead = self._dead.get(peer_id, True)
+        if executor is None or dead:
+            raise PeerUnavailable(f"peer {peer_id} is not reachable")
+        return executor.backward(request)

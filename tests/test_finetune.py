@@ -1,0 +1,200 @@
+"""Distributed fine-tuning (deep prompt tuning) vs single-device oracle.
+
+The vendored reference training path (``rpc_backward`` + per-block prompts,
+``petals/server/handler.py:434-488``, ``block_functions.py:57-65``) was never
+runnable; here the full client-driven step — local embed/span, remote
+train_forward hops, local head loss, reversed remote backward hops, AdamW —
+must produce gradients identical to an unpartitioned jax.grad.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+    gpt2_config,
+    init_params,
+    llama_config,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.transformer import (
+    embed_tokens,
+    lm_head,
+    stack_forward_train,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.parallel.trainer import (
+    softmax_xent,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.finetune import (
+    DistributedFineTuner,
+)
+
+from test_runtime_pipeline import build_cluster, tiny_cfg
+
+
+def oracle_ptune_loss(cfg, params, prompts, ids, targets):
+    """Unpartitioned deep-prompt-tuning loss (all blocks, prompts at every
+    block) — what the local+remote split must equal."""
+    b, t = ids.shape
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
+    x = embed_tokens(cfg, params["embed"], ids, positions)
+    x = stack_forward_train(cfg, params["layers"], x, positions,
+                            prompts=prompts, remat=False)
+    return softmax_xent(lm_head(cfg, params, x), targets)
+
+
+def make_batch(cfg, b, t, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, cfg.vocab_size, size=(b, t)).astype(np.int32)
+    targets = np.concatenate([ids[:, 1:], np.full((b, 1), -1, np.int32)], axis=1)
+    return jnp.asarray(ids), jnp.asarray(targets)
+
+
+def make_tuner(cfg, params, client, **kw):
+    head = {"final_norm": params["final_norm"]}
+    if not cfg.tie_word_embeddings:
+        head["lm_head"] = params["lm_head"]
+    return DistributedFineTuner(cfg, client, head, **kw)
+
+
+def test_distributed_ptune_grads_match_oracle():
+    cfg = tiny_cfg()  # llama, 8 layers
+    client, transport, registry, params, plan = build_cluster(cfg, splits="2,4,6")
+    ids, targets = make_batch(cfg, 2, 12)
+
+    ft = make_tuner(cfg, params, client, pre_seq=4, lr=0.0, tune_embed=True)
+    prompts0 = ft.trainables["prompts"]
+
+    g_oracle = jax.grad(
+        lambda pr, wte: oracle_ptune_loss(
+            cfg,
+            {**params, "embed": {**params["embed"], "wte": wte}},
+            pr, ids, targets),
+        argnums=(0, 1),
+    )(prompts0, params["embed"]["wte"])
+
+    loss = ft.step(ids, targets)
+    oracle_loss = float(oracle_ptune_loss(cfg, params, prompts0, ids, targets))
+    np.testing.assert_allclose(loss, oracle_loss, rtol=1e-4)
+
+    # lr=0: grads live in the first AdamW moment (mu = 0.1 * g).
+    g_prompts = np.asarray(ft.opt_state["mu"]["prompts"]) / 0.1
+    g_wte = np.asarray(ft.opt_state["mu"]["embed"]["wte"]) / 0.1
+    np.testing.assert_allclose(g_prompts, np.asarray(g_oracle[0]),
+                               rtol=2e-3, atol=1e-6)
+    np.testing.assert_allclose(g_wte, np.asarray(g_oracle[1]),
+                               rtol=2e-3, atol=2e-6)
+
+
+def test_distributed_ptune_learns_gpt2():
+    cfg = tiny_cfg("gpt2")  # tied embeddings path
+    client, *_ = build_cluster(cfg, splits="4")
+    ids, targets = make_batch(cfg, 2, 16, seed=5)
+    # final_norm lives on the remote last stage; identity LN weights stand in
+    # for it client-side — fine for a does-it-learn test.
+    ft = DistributedFineTuner(
+        cfg, client,
+        {"final_norm": {"w": jnp.ones((cfg.hidden_size,)),
+                        "b": jnp.zeros((cfg.hidden_size,))}},
+        pre_seq=4, lr=5e-2,
+    )
+    first = ft.step(ids, targets)
+    for _ in range(8):
+        last = ft.step(ids, targets)
+    assert last < first, (first, last)
+
+
+def test_ptune_short_sequence_clamps_prompts():
+    """Regression: T < pre_seq must not crash; prompts clamp to the first T
+    rows consistently on the local span and the bucket-padded remote spans,
+    and the unused prompt tail gets zero gradient."""
+    cfg = tiny_cfg()
+    client, transport, registry, params, plan = build_cluster(cfg, splits="2,4,6")
+    ids, targets = make_batch(cfg, 1, 4)  # T=4 < pre_seq=8
+    ft = make_tuner(cfg, params, client, pre_seq=8, lr=0.0)
+    prompts0 = ft.trainables["prompts"]
+    loss = ft.step(ids, targets)
+    oracle = float(oracle_ptune_loss(cfg, params, prompts0, ids, targets))
+    np.testing.assert_allclose(loss, oracle, rtol=1e-4)
+    g_prompts = np.asarray(ft.opt_state["mu"]["prompts"]) / 0.1
+    assert np.all(g_prompts[:, 4:] == 0.0)
+    assert np.any(g_prompts[:, :4] != 0.0)
+
+
+def test_ptune_survives_peer_failure():
+    """Kill the pinned middle peer mid-run: training is stateless server-side,
+    so the step must re-route to the replica and continue."""
+    cfg = tiny_cfg()
+    client, transport, registry, params, plan = build_cluster(
+        cfg, splits="2,4,6", replicas=2)
+    ids, targets = make_batch(cfg, 1, 8)
+    ft = make_tuner(cfg, params, client, pre_seq=2, lr=1e-2)
+    l1 = ft.step(ids, targets)
+    victim = client.route()[1].peer_id
+    transport.kill(victim)
+    l2 = ft.step(ids, targets)  # must not raise
+    assert np.isfinite(l2)
+    assert ft.steps == 2
+
+
+def test_ptune_over_tcp():
+    """Same step over real sockets (train_forward/backward verbs + multi-
+    tensor frames), f32 wire for grads."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.partition import (
+        StagePlan,
+        parse_splits,
+        slice_stage_params,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.client import (
+        PipelineClient,
+        make_server_record,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.executor import (
+        StageExecutor,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.net import (
+        TcpStageServer,
+        TcpTransport,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.scheduling.registry import (
+        PlacementRegistry,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("3,6"))
+    registry = PlacementRegistry(rng=random.Random(0))
+    servers = []
+    try:
+        for spec in plan.stages[1:]:
+            peer = f"tcp-s{spec.index}"
+            ex = StageExecutor(cfg, spec, slice_stage_params(cfg, params, spec),
+                               peer_id=peer)
+            srv = TcpStageServer(ex, wire_dtype="f32")
+            srv.start()
+            servers.append(srv)
+            rec = make_server_record(peer, spec)
+            rec.address = srv.address
+            registry.register(rec)
+        stage0 = StageExecutor(cfg, plan.stages[0],
+                               slice_stage_params(cfg, params, plan.stages[0]),
+                               peer_id="client-local")
+        transport = TcpTransport(registry, wire_dtype="f32")
+        client = PipelineClient(cfg, plan, stage0, transport, registry,
+                                settle_seconds=0.0)
+        ids, targets = make_batch(cfg, 1, 8)
+        ft = make_tuner(cfg, params, client, pre_seq=2, lr=0.0)
+        prompts0 = ft.trainables["prompts"]
+        loss = ft.step(ids, targets)
+        oracle = float(oracle_ptune_loss(cfg, params, prompts0, ids, targets))
+        np.testing.assert_allclose(loss, oracle, rtol=1e-4)
+        g_prompts = np.asarray(ft.opt_state["mu"]["prompts"]) / 0.1
+        g_oracle = jax.grad(
+            lambda pr: oracle_ptune_loss(cfg, params, pr, ids, targets)
+        )(prompts0)
+        np.testing.assert_allclose(g_prompts, np.asarray(g_oracle),
+                                   rtol=2e-3, atol=1e-6)
+    finally:
+        for srv in servers:
+            srv.stop()
