@@ -98,6 +98,7 @@ class PipelineClient:
         registry: PlacementRegistry,
         *,
         use_module_routing: bool = False,
+        use_push_chain: bool = False,
         total_blocks: Optional[int] = None,
         request_timeout: float = 60.0,
         settle_seconds: float = SETTLE_SECONDS,
@@ -110,6 +111,7 @@ class PipelineClient:
         self.transport = transport
         self.registry = registry
         self.use_module_routing = use_module_routing
+        self.use_push_chain = use_push_chain
         self.total_blocks = total_blocks or cfg.num_layers
         self.request_timeout = request_timeout
         self.settle_seconds = settle_seconds
@@ -309,6 +311,12 @@ class PipelineClient:
               sampling: SamplingParams, generated: Sequence[int],
               step_seed: int, stage_times: Dict[str, float]) -> int:
         """Send the activation through every remote hop; return the token."""
+        if self.use_push_chain:
+            return self._walk_chain(
+                hidden, seq_len, cur_len, session_id, is_prefill=is_prefill,
+                max_length=max_length, sampling=sampling, generated=generated,
+                step_seed=step_seed, stage_times=stage_times,
+            )
         cur = hidden
         token: Optional[int] = None
         for hop in self.route():
@@ -347,6 +355,140 @@ class PipelineClient:
                 cur = resp.hidden
         assert token is not None, "route had no final hop"
         return token
+
+    # ------------------------------------------------------------------
+    # Push-chain walk (petals handler.py:320-350 server→server push): the
+    # client makes ONE call per step; servers relay activations hop-to-hop
+    # and the final token rides the relay chain back. The journal then holds
+    # only stage0 outputs (key "chain") — recovery replays them through a
+    # freshly-routed chain, rebuilding every hop's KV at once.
+    # ------------------------------------------------------------------
+
+    CHAIN_KEY = "chain"
+
+    def _chain_request(self, hops: List[Hop], hidden, seq_len: int,
+                       cur_len: int, session_id: str, *, is_prefill: bool,
+                       is_replay: bool, max_length: int,
+                       sampling: SamplingParams, generated: Sequence[int],
+                       step_seed: int) -> StageRequest:
+        nxt = []
+        for h in hops[1:]:
+            rec = self.registry.get(h.peer_id)
+            nxt.append({
+                "peer_id": h.peer_id,
+                "address": getattr(rec, "address", None) if rec else None,
+                "start_block": h.start_block,
+                "end_block": h.end_block,
+            })
+        return StageRequest(
+            session_id=session_id, hidden=hidden, seq_len=seq_len,
+            cur_len=cur_len, is_prefill=is_prefill, is_replay=is_replay,
+            max_length=max_length, sampling=sampling,
+            generated_tokens=clip_generated(generated), step_seed=step_seed,
+            start_block=hops[0].start_block, end_block=hops[0].end_block,
+            next_servers=tuple(nxt),
+        )
+
+    def _replay_chain(self, hops: List[Hop], session_id: str,
+                      sampling: SamplingParams, max_length: int) -> None:
+        entries = self.journal.get(self.CHAIN_KEY, {}).get(session_id, [])
+        for i, e in enumerate(entries):
+            req = self._chain_request(
+                hops, jnp.asarray(e.hidden), e.seq_len, e.cur_len, session_id,
+                is_prefill=(i == 0), is_replay=True, max_length=max_length,
+                sampling=sampling, generated=(), step_seed=0,
+            )
+            self.transport.call(hops[0].peer_id, req,
+                                timeout=self.request_timeout)
+
+    def _blame_chain_failure(self, hops: List[Hop], exc: Exception) -> None:
+        """Blacklist the hop responsible for a chain failure and invalidate
+        the cached route. Server-relayed errors carry the true origin peer;
+        a bare client-side timeout has no attribution, so probe hop liveness
+        to find the dead one (a hung host usually stops accepting
+        connections) before defaulting to the entry hop."""
+        blame = getattr(exc, "peer_id", None)
+        if blame is None and isinstance(exc, TimeoutError):
+            blame = next(
+                (h.peer_id for h in hops
+                 if not self.transport.alive(h.peer_id)), None,
+            )
+        blame = blame or hops[0].peer_id
+        blamed_hop = next((h for h in hops if h.peer_id == blame), hops[0])
+        self.failed_peers.setdefault(blamed_hop.key, set()).add(blame)
+        self._route = None  # recompute with the blacklist applied
+        logger.warning("push chain failed at %s: %s", blame, exc)
+
+    def _walk_chain(self, hidden, seq_len: int, cur_len: int, session_id: str,
+                    *, is_prefill: bool, max_length: int,
+                    sampling: SamplingParams, generated: Sequence[int],
+                    step_seed: int, stage_times: Dict[str, float]) -> int:
+        touched = self._session_peers.setdefault(session_id, set())
+        last_exc: Optional[Exception] = None
+        blacklist_cleared = False
+        for attempt in range(MAX_ATTEMPTS):
+            try:
+                hops = self.route()
+            except NoRouteError as exc:
+                last_exc = exc
+                if blacklist_cleared:
+                    continue
+                # Every candidate is blacklisted — transient failures must
+                # not wedge the client forever (same amnesty as the per-hop
+                # path's _rediscover, client.py _rediscover).
+                blacklist_cleared = True
+                self.failed_peers.clear()
+                self._route = None
+                continue
+            touched.update(h.peer_id for h in hops)
+            req = self._chain_request(
+                hops, hidden, seq_len, cur_len, session_id,
+                is_prefill=is_prefill, is_replay=attempt > 0,
+                max_length=max_length, sampling=sampling, generated=generated,
+                step_seed=step_seed,
+            )
+            t0 = time.monotonic()
+            try:
+                resp = self.transport.call(
+                    hops[0].peer_id, req,
+                    # the chain spans len(hops) computes before responding
+                    timeout=self.request_timeout * max(1, len(hops)),
+                )
+            except (PeerUnavailable, TimeoutError, ConnectionError,
+                    StageExecutionError) as exc:
+                last_exc = exc
+                self._blame_chain_failure(hops, exc)
+                try:
+                    new_hops = self.route()
+                    self._replay_chain(new_hops, session_id, sampling,
+                                       max_length)
+                except NoRouteError as rexc:
+                    last_exc = rexc
+                    continue
+                except (PeerUnavailable, TimeoutError, ConnectionError,
+                        StageExecutionError) as rexc:
+                    # A peer died DURING replay: blame it too so the next
+                    # attempt routes around it instead of repeating the
+                    # identical failing chain.
+                    last_exc = rexc
+                    self._blame_chain_failure(new_hops, rexc)
+                    continue
+                self.recoveries += 1
+                if self.settle_seconds:
+                    time.sleep(self.settle_seconds)
+                continue
+            stage_times[self.CHAIN_KEY] = time.monotonic() - t0
+            self._journal_append(
+                self.CHAIN_KEY, session_id,
+                JournalEntry(np.asarray(hidden), seq_len, cur_len),
+            )
+            if not resp.is_token:
+                raise RuntimeError("push chain returned no token "
+                                   "(route must end at the final stage)")
+            return resp.token_id
+        raise RuntimeError(
+            f"push chain: all {MAX_ATTEMPTS} attempts failed"
+        ) from last_exc
 
     # ------------------------------------------------------------------
     # Generation (run_rank0, src/main.py:62-227)

@@ -53,6 +53,14 @@ class StageRequest:
     # prompts added into the first positions of each block's input.
     train: bool = False
     prompts: Optional[jnp.ndarray] = None   # [span_layers, pre_seq, D]
+    # Push-chain route (the ``next_servers`` metadata of Petals'
+    # server→server push, ``petals/server/handler.py:320-350``): the hops
+    # AFTER this one. A server that produced hidden output forwards it
+    # directly to next_servers[0] (relaying the eventual final response back
+    # up) instead of bouncing through the client — one client round trip per
+    # step instead of one per hop. Entries: {peer_id, address?, start_block,
+    # end_block}.
+    next_servers: Tuple[dict, ...] = ()
 
 
 @dataclasses.dataclass

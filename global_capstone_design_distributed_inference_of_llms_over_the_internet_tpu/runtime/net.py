@@ -49,7 +49,7 @@ from ..scheduling.registry import PlacementRegistry, ServerRecord
 from .executor import StageExecutionError, StageExecutor
 from .messages import BackwardRequest, StageRequest, StageResponse
 from .task_pool import StageRuntime, TaskRejected
-from .transport import PeerUnavailable, Transport
+from .transport import PeerUnavailable, PushChainError, Transport
 
 logger = logging.getLogger(__name__)
 
@@ -160,6 +160,7 @@ def _request_header(req: StageRequest, tensor_meta: dict) -> dict:
         "step_seed": req.step_seed,
         "start_block": req.start_block,
         "end_block": req.end_block,
+        "next_servers": list(req.next_servers),
         "tensor": tensor_meta,
     }
 
@@ -182,6 +183,7 @@ def _header_to_request(h: dict, payload: bytes) -> StageRequest:
         step_seed=h.get("step_seed", 0),
         start_block=h.get("start_block"),
         end_block=h.get("end_block"),
+        next_servers=tuple(h.get("next_servers", ())),
     )
 
 
@@ -290,6 +292,9 @@ class TcpStageServer(_FramedTcpServer):
         self.wire_dtype = wire_dtype
         self.runtime = runtime
         self.compute_timeout = compute_timeout
+        # addr -> (socket, per-connection send/recv lock)
+        self._relay_conns: Dict[str, tuple] = {}
+        self._relay_lock = threading.Lock()
         # Several stage servers on one host may SHARE one runtime (one chip,
         # one compute thread): only the owner may start/stop it, otherwise an
         # elastic teardown of server A would kill server B's compute.
@@ -301,6 +306,56 @@ class TcpStageServer(_FramedTcpServer):
             return fn(*args)
         return self.runtime.call(kind, fn, *args, size=size,
                                  timeout=self.compute_timeout)
+
+    def _relay(self, nxt: dict, nreq: StageRequest) -> Tuple[dict, bytes]:
+        """Send a push-chain request to the next hop, return its raw response
+        frame for verbatim upstream relay. Connections are pooled per address
+        (decode pushes one small tensor per token — a fresh TCP connect per
+        step would add an RTT per hop per token, cancelling the feature's
+        point on WAN links); a stale pooled socket gets one reconnect."""
+        addr = nxt.get("address")
+        if not addr:
+            raise ConnectionError(f"no address for push target {nxt}")
+        arr = np.asarray(nreq.hidden)
+        meta, body = _encode_tensor(arr, self.wire_dtype)
+        # The downstream response covers the REST of the chain's computes.
+        timeout = self.compute_timeout * (1 + len(nreq.next_servers))
+        for fresh in (False, True):
+            sock, lock = self._relay_sock(addr, fresh)
+            try:
+                # Per-connection lock: concurrent handler threads relaying to
+                # the same next hop must not interleave frames on one socket.
+                with lock:
+                    sock.settimeout(timeout)
+                    _send_frame(sock, _request_header(nreq, meta), body)
+                    return _recv_frame(sock)
+            except (ConnectionError, OSError):
+                self._drop_relay(addr, sock)
+                if fresh:
+                    raise
+        raise ConnectionError("unreachable")  # pragma: no cover
+
+    def _relay_sock(self, addr: str, fresh: bool):
+        with self._relay_lock:
+            if not fresh:
+                entry = self._relay_conns.get(addr)
+                if entry is not None:
+                    return entry
+            host, port = addr.rsplit(":", 1)
+            sock = socket.create_connection((host, int(port)), timeout=5.0)
+            entry = (sock, threading.Lock())
+            self._relay_conns[addr] = entry
+            return entry
+
+    def _drop_relay(self, addr: str, sock: socket.socket) -> None:
+        with self._relay_lock:
+            entry = self._relay_conns.get(addr)
+            if entry is not None and entry[0] is sock:
+                del self._relay_conns[addr]
+        try:
+            sock.close()
+        except OSError:
+            pass
 
     def start(self) -> None:
         super().start()
@@ -314,6 +369,13 @@ class TcpStageServer(_FramedTcpServer):
         super().stop()
         if self.runtime is not None and self.owns_runtime:
             self.runtime.stop()
+        with self._relay_lock:
+            conns, self._relay_conns = dict(self._relay_conns), {}
+        for sock, _ in conns.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def _dispatch(self, sock, header: dict, payload: bytes) -> None:
         verb = header.get("verb")
@@ -330,10 +392,12 @@ class TcpStageServer(_FramedTcpServer):
             # would otherwise silently drop the connection.
             except (StageExecutionError, TaskRejected) as exc:
                 _send_frame(sock, {"verb": "error", "message": str(exc),
-                                   "kind": "stage"})
+                                   "kind": "stage",
+                                   "peer": self.executor.peer_id})
                 return
             except TimeoutError:
                 _send_frame(sock, {"verb": "error", "kind": "stage",
+                                   "peer": self.executor.peer_id,
                                    "message": f"stage compute timed out after "
                                               f"{self.compute_timeout:.0f}s"})
                 return
@@ -342,6 +406,28 @@ class TcpStageServer(_FramedTcpServer):
                     "verb": "token", "session_id": resp.session_id,
                     "token_id": resp.token_id, "cache_len": resp.cache_len,
                 })
+            elif req.next_servers:
+                # Push chain (petals handler.py:320-350): ship our output
+                # straight to the next hop and relay its final response back
+                # upstream — the client sees ONE round trip per step.
+                nxt = req.next_servers[0]
+                nreq = dataclasses.replace(
+                    req,
+                    hidden=resp.hidden,
+                    start_block=nxt.get("start_block"),
+                    end_block=nxt.get("end_block"),
+                    next_servers=tuple(req.next_servers[1:]),
+                )
+                try:
+                    rh, rp = self._relay(nxt, nreq)
+                except (ConnectionError, OSError, TimeoutError) as exc:
+                    _send_frame(sock, {
+                        "verb": "error", "kind": "push",
+                        "peer": nxt.get("peer_id", "?"),
+                        "message": f"push to {nxt.get('peer_id')} failed: {exc}",
+                    })
+                    return
+                _send_frame(sock, rh, rp)
             else:
                 arr = np.asarray(resp.hidden)
                 meta, body = _encode_tensor(arr, self.wire_dtype)
@@ -525,8 +611,14 @@ class TcpTransport(Transport):
                 cache_len=header["cache_len"],
             )
         if verb == "error":
+            if header.get("kind") == "push":
+                raise PushChainError(header.get("peer", "?"),
+                                     header.get("message", "push failed"))
             if header.get("kind") == "stage":
-                raise StageExecutionError(header.get("message", "stage error"))
+                exc = StageExecutionError(header.get("message", "stage error"))
+                # Chain mode: the error may originate from a downstream hop.
+                exc.peer_id = header.get("peer")
+                raise exc
             raise RuntimeError(f"peer {peer_id} error: {header.get('message')}")
         raise WireError(f"unexpected response verb {verb!r}")
 

@@ -43,6 +43,15 @@ class PeerUnavailable(ConnectionError):
     """The peer is dead/unreachable (client must fail over)."""
 
 
+class PushChainError(ConnectionError):
+    """A DOWNSTREAM hop of a push chain failed. Carries the failing peer so
+    the client blacklists the right server, not the chain's entry point."""
+
+    def __init__(self, peer_id: str, message: str):
+        super().__init__(message)
+        self.peer_id = peer_id
+
+
 class Transport(abc.ABC):
     """Client-side view: submit a request to a named peer."""
 
@@ -166,7 +175,31 @@ class LocalTransport(Transport):
             time.sleep(stall)
         if request.train:
             return executor.train_forward(request)
-        return executor.forward(request)
+        resp = executor.forward(request)
+        if request.next_servers and resp.hidden is not None:
+            # Push chain: forward the output straight to the next hop and
+            # relay its (eventual final) response. Downstream failures are
+            # attributed to the downstream peer.
+            import dataclasses as _dc
+
+            from .executor import StageExecutionError
+
+            nxt = request.next_servers[0]
+            nreq = _dc.replace(
+                request,
+                hidden=resp.hidden,
+                start_block=nxt.get("start_block"),
+                end_block=nxt.get("end_block"),
+                next_servers=tuple(request.next_servers[1:]),
+            )
+            try:
+                return self.call(nxt["peer_id"], nreq, timeout)
+            except PushChainError:
+                raise
+            except (PeerUnavailable, TimeoutError, ConnectionError,
+                    StageExecutionError) as exc:
+                raise PushChainError(nxt["peer_id"], str(exc)) from exc
+        return resp
 
     def backward(self, peer_id: str, request: BackwardRequest,
                  timeout: Optional[float] = None) -> BackwardResponse:
