@@ -55,6 +55,30 @@ class NoRouteError(RuntimeError):
     """No live servers cover the required span (route computation failed)."""
 
 
+def _merge_entries(a: "JournalEntry", b: "JournalEntry") -> "JournalEntry":
+    """Coalesce two adjacent journal entries into one replayable chunk.
+
+    When `b` carries a beam reorder, the reorder is hoisted to the front of
+    the merged chunk by permutation composition: replaying
+    ``[reorder p_a; tokens A; reorder p_b; tokens B]`` equals
+    ``[reorder p_a∘p_b; tokens A[p_b]; tokens B]`` — merged row j takes its
+    A-tokens from A's row ``p_b[j]`` and its prefix KV from row
+    ``p_a[p_b[j]]``, exactly what the two-entry replay produced. (Because
+    rows attend only to their own KV, permuting whole rows commutes with the
+    step.) This keeps beam-session journals bounded — without composition no
+    reorder-carrying pair could ever merge."""
+    if b.hypo_ids is None:
+        hidden = np.concatenate([a.hidden, b.hidden], axis=1)
+        hypo = a.hypo_ids
+    else:
+        sel = np.asarray(b.hypo_ids, np.int64)
+        hidden = np.concatenate([a.hidden[sel], b.hidden], axis=1)
+        hypo = (tuple(b.hypo_ids) if a.hypo_ids is None
+                else tuple(a.hypo_ids[i] for i in b.hypo_ids))
+    return JournalEntry(hidden=hidden, seq_len=a.seq_len + b.seq_len,
+                        cur_len=a.cur_len, hypo_ids=hypo)
+
+
 @dataclasses.dataclass
 class Hop:
     """One remote hop of the route: a pinned peer serving [start, end)."""
@@ -71,6 +95,17 @@ class JournalEntry:
     hidden: np.ndarray       # [B, T, D] activation as sent
     seq_len: int
     cur_len: int             # session length before this entry
+    # Beam reorder applied BEFORE this entry's step (replay must re-apply it
+    # in order, or the rebuilt KV rows belong to the wrong hypotheses).
+    hypo_ids: Optional[Tuple[int, ...]] = None
+
+
+@dataclasses.dataclass
+class BeamResult:
+    tokens: List[int]        # best hypothesis (new tokens only)
+    score: float             # length-normalized log-probability
+    num_beams: int
+    ttft_s: float
 
 
 @dataclasses.dataclass
@@ -204,11 +239,7 @@ class PipelineClient:
             for i in range(len(entries) - 1):
                 a, b = entries[i], entries[i + 1]
                 if a.seq_len + b.seq_len <= MAX_COALESCED_TOKENS:
-                    entries[i:i + 2] = [JournalEntry(
-                        hidden=np.concatenate([a.hidden, b.hidden], axis=1),
-                        seq_len=a.seq_len + b.seq_len,
-                        cur_len=a.cur_len,
-                    )]
+                    entries[i:i + 2] = [_merge_entries(a, b)]
                     break
 
     def _replay(self, hop: Hop, session_id: str, sampling: SamplingParams,
@@ -229,6 +260,7 @@ class PipelineClient:
                 sampling=sampling,
                 start_block=hop.start_block,
                 end_block=hop.end_block,
+                hypo_ids=None if i == 0 else e.hypo_ids,
             )
             self.transport.call(hop.peer_id, req, timeout=self.request_timeout)
 
@@ -308,9 +340,15 @@ class PipelineClient:
 
     def _walk(self, hidden: jnp.ndarray, seq_len: int, cur_len: int,
               session_id: str, *, is_prefill: bool, max_length: int,
-              sampling: SamplingParams, generated: Sequence[int],
-              step_seed: int, stage_times: Dict[str, float]) -> int:
-        """Send the activation through every remote hop; return the token."""
+              sampling: Optional[SamplingParams] = None,
+              generated: Sequence[int] = (), step_seed: int = 0,
+              stage_times: Dict[str, float],
+              hypo_ids: Optional[Tuple[int, ...]] = None,
+              num_logprobs: int = 0) -> StageResponse:
+        """Send the activation through every remote hop; return the final
+        hop's response: a sampled token, or (num_logprobs > 0, beam mode)
+        per-row top-N candidates."""
+        sampling = sampling or SamplingParams()
         if self.use_push_chain:
             return self._walk_chain(
                 hidden, seq_len, cur_len, session_id, is_prefill=is_prefill,
@@ -318,7 +356,6 @@ class PipelineClient:
                 step_seed=step_seed, stage_times=stage_times,
             )
         cur = hidden
-        token: Optional[int] = None
         for hop in self.route():
             req = StageRequest(
                 session_id=session_id,
@@ -332,6 +369,8 @@ class PipelineClient:
                 step_seed=step_seed,
                 start_block=hop.start_block,
                 end_block=hop.end_block,
+                hypo_ids=hypo_ids,
+                num_logprobs=num_logprobs,
             )
             t0 = time.monotonic()
             resp = self._call_with_recovery(hop, req)
@@ -343,18 +382,22 @@ class PipelineClient:
             # vs `:648-654` — re-applying the current step; we fix that.)
             self._journal_append(
                 hop.key, session_id,
-                JournalEntry(np.asarray(cur), seq_len, cur_len),
+                JournalEntry(np.asarray(cur), seq_len, cur_len,
+                             hypo_ids=hypo_ids),
             )
             if hop.expect_token:
-                if not resp.is_token:
+                if num_logprobs > 0:
+                    if not resp.is_beam:
+                        raise RuntimeError(
+                            f"final hop {hop.key} returned no beam candidates"
+                        )
+                elif not resp.is_token:
                     raise RuntimeError(f"final hop {hop.key} returned no token")
-                token = resp.token_id
-            else:
-                if resp.hidden is None:
-                    raise RuntimeError(f"hop {hop.key} returned no hidden states")
-                cur = resp.hidden
-        assert token is not None, "route had no final hop"
-        return token
+                return resp
+            if resp.hidden is None:
+                raise RuntimeError(f"hop {hop.key} returned no hidden states")
+            cur = resp.hidden
+        raise RuntimeError("route had no final hop")
 
     # ------------------------------------------------------------------
     # Push-chain walk (petals handler.py:320-350 server→server push): the
@@ -422,7 +465,8 @@ class PipelineClient:
     def _walk_chain(self, hidden, seq_len: int, cur_len: int, session_id: str,
                     *, is_prefill: bool, max_length: int,
                     sampling: SamplingParams, generated: Sequence[int],
-                    step_seed: int, stage_times: Dict[str, float]) -> int:
+                    step_seed: int,
+                    stage_times: Dict[str, float]) -> StageResponse:
         touched = self._session_peers.setdefault(session_id, set())
         last_exc: Optional[Exception] = None
         blacklist_cleared = False
@@ -485,7 +529,7 @@ class PipelineClient:
             if not resp.is_token:
                 raise RuntimeError("push chain returned no token "
                                    "(route must end at the final stage)")
-            return resp.token_id
+            return resp
         raise RuntimeError(
             f"push chain: all {MAX_ATTEMPTS} attempts failed"
         ) from last_exc
@@ -520,14 +564,14 @@ class PipelineClient:
             is_prefill=True, max_length=max_length, sampling=sampling,
         ))
         times: Dict[str, float] = {}
-        token = self._walk(
+        resp = self._walk(
             s0_resp.hidden, prompt_len, 0, session_id,
             is_prefill=True, max_length=max_length, sampling=sampling,
             generated=generated, step_seed=self.seed, stage_times=times,
         )
         ttft = time.monotonic() - t0
         self.last_prefill_stage_times = times
-        generated.append(token)
+        generated.append(resp.token_id)
 
         # ---- decode loop (src/main.py:164-211) ----
         decode_times: List[float] = []
@@ -549,7 +593,7 @@ class PipelineClient:
                 sampling=sampling,
             ))
             times = {}
-            token = self._walk(
+            resp = self._walk(
                 s0_resp.hidden, 1, cur_len, session_id,
                 is_prefill=False, max_length=max_length, sampling=sampling,
                 generated=generated, step_seed=self.seed + step,
@@ -557,7 +601,7 @@ class PipelineClient:
             )
             decode_times.append(time.monotonic() - t0)
             self.decode_stage_history.append(times)
-            generated.append(token)
+            generated.append(resp.token_id)
             cur_len += 1
 
         self._end_session(session_id)
@@ -565,6 +609,128 @@ class PipelineClient:
             tokens=generated, ttft_s=ttft, decode_times_s=decode_times,
             stopped_by=stopped_by,
         )
+
+    # ------------------------------------------------------------------
+    # Beam search (client-side bookkeeping; servers reorder KV by hypo_ids —
+    # petals backend.py:154-158 — and the final stage returns top-N logprobs)
+    # ------------------------------------------------------------------
+
+    def beam_search(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int = 64,
+        num_beams: int = 4,
+        *,
+        length_penalty: float = 1.0,
+        eos_token_id: Optional[int] = None,
+        session_id: Optional[str] = None,
+        max_length: Optional[int] = None,
+    ) -> "BeamResult":
+        """Distributed beam search. The session holds num_beams KV rows on
+        every stage; each step ships hypo_ids so servers reorder their rows
+        to match the surviving hypotheses before computing. The prompt is
+        prefilled ONCE at batch 1 — the first decode step's hypo_ids
+        ``(0,)*num_beams`` expands every stage's KV to num_beams rows, so no
+        stage ever runs the (num_beams-1)× redundant identical prefill."""
+        if self.use_push_chain:
+            raise ValueError("beam search uses the per-hop walk; disable "
+                             "use_push_chain")
+        session_id = session_id or f"beam-{time.monotonic_ns():x}"
+        prompt_len = len(prompt_ids)
+        max_length = max_length or (prompt_len + max_new_tokens)
+        nb = num_beams
+        topn = 2 * nb  # candidate pool per row (HF convention)
+
+        ids = jnp.asarray(np.asarray(prompt_ids, np.int32))[None, :]
+        t0 = time.monotonic()
+        s0_resp = self.stage0.forward(StageRequest(
+            session_id=session_id, hidden=ids, seq_len=prompt_len, cur_len=0,
+            is_prefill=True, max_length=max_length,
+        ))
+        times: Dict[str, float] = {}
+        resp = self._walk(
+            s0_resp.hidden, prompt_len, 0, session_id, is_prefill=True,
+            max_length=max_length, num_logprobs=topn, stage_times=times,
+        )
+        ttft = time.monotonic() - t0
+        self.last_prefill_stage_times = times
+
+        def norm(score: float, length: int) -> float:
+            return score / (max(length, 1) ** length_penalty)
+
+        # All prefill rows are identical: seed the beams from row 0, applying
+        # the same EOS policy as every later step (an EOS first token is a
+        # finished 1-token hypothesis, not a live beam).
+        beams: List[List[int]] = []
+        scores: List[float] = []
+        finished: List[Tuple[float, List[int]]] = []
+        for tok, lp in zip(resp.top_tokens[0], resp.top_logprobs[0]):
+            if eos_token_id is not None and tok == eos_token_id:
+                finished.append((norm(float(lp), 1), [int(tok)]))
+                continue
+            beams.append([int(tok)])
+            scores.append(float(lp))
+            if len(beams) == nb:
+                break
+        # The prefill left ONE KV row; the first decode step's (0,)*nb
+        # "reorder" expands it to nb beam rows on every stage.
+        identity = tuple(range(nb))
+        parents = (0,) * nb
+        cur_len = prompt_len
+
+        for _ in range(1, max_new_tokens):
+            # Identity reorders carry no information; normalizing them to
+            # None keeps journal entries coalescible without composition.
+            hypo = None if parents == identity else parents
+            step_ids = jnp.asarray(
+                np.asarray([b[-1] for b in beams], np.int32)[:, None]
+            )
+            s0_resp = self.stage0.forward(StageRequest(
+                session_id=session_id, hidden=step_ids, seq_len=1,
+                cur_len=cur_len, is_prefill=False, max_length=max_length,
+                hypo_ids=hypo,
+            ))
+            times = {}
+            resp = self._walk(
+                s0_resp.hidden, 1, cur_len, session_id,
+                is_prefill=False, max_length=max_length, num_logprobs=topn,
+                hypo_ids=hypo, stage_times=times,
+            )
+            self.decode_stage_history.append(times)
+            cur_len += 1
+
+            cands = []
+            for i in range(nb):
+                for tok, lp in zip(resp.top_tokens[i], resp.top_logprobs[i]):
+                    cands.append((scores[i] + float(lp), i, int(tok)))
+            cands.sort(key=lambda c: c[0], reverse=True)
+
+            new_beams, new_scores, new_parents = [], [], []
+            for score, parent, tok in cands:
+                if eos_token_id is not None and tok == eos_token_id:
+                    finished.append(
+                        (norm(score, len(beams[parent]) + 1),
+                         beams[parent] + [tok])
+                    )
+                    continue
+                new_beams.append(beams[parent] + [tok])
+                new_scores.append(score)
+                new_parents.append(parent)
+                if len(new_beams) == nb:
+                    break
+            beams, scores, parents = new_beams, new_scores, tuple(new_parents)
+
+            if finished and len(finished) >= nb:
+                best_live = norm(max(scores), len(beams[0]))
+                if max(f[0] for f in finished) >= best_live:
+                    break
+
+        for score, beam in zip(scores, beams):
+            finished.append((norm(score, len(beam)), beam))
+        finished.sort(key=lambda f: f[0], reverse=True)
+        self._end_session(session_id)
+        return BeamResult(tokens=finished[0][1], score=finished[0][0],
+                          num_beams=nb, ttft_s=ttft)
 
     def _end_session(self, session_id: str) -> None:
         self.stage0.drop_session(session_id)

@@ -45,7 +45,7 @@ from ..models.partition import (
 )
 from ..ops.sampling import RECENT_WINDOW, sample_token
 from ..models.transformer import stack_forward_train
-from .kv_cache import KVArena, KVHandle, round_to_bucket
+from .kv_cache import AllocationFailed, KVArena, KVHandle, round_to_bucket
 from .messages import (
     BackwardRequest,
     BackwardResponse,
@@ -163,22 +163,32 @@ class StageExecutor:
     # Session / cache management (mirrors rpc_handler session semantics)
     # ------------------------------------------------------------------
 
-    def _session_cache(self, req: StageRequest, num_layers: int) -> KVHandle:
+    def _allocate(self, req: StageRequest, num_layers: int, batch: int) -> KVHandle:
+        """Arena lease as a STAGE error: a full arena is peer-local state —
+        surfacing it as StageExecutionError puts it in the client's retryable
+        taxonomy, so the session fails over to a replica with free memory
+        instead of crashing the generation."""
+        try:
+            return self.arena.allocate(req.session_id, req.max_length,
+                                       num_layers=num_layers, batch=batch)
+        except AllocationFailed as exc:
+            raise StageExecutionError(str(exc)) from exc
+
+    def _session_cache(self, req: StageRequest, num_layers: int,
+                       batch: int = 1) -> KVHandle:
         handle = self.arena.get(req.session_id)
         if req.is_prefill:
             # Prefill (re)starts the session: clear existing cache
             # (src/rpc_handler.py:180-182).
             if handle is not None:
                 self.arena.free(req.session_id)
-            handle = self.arena.allocate(req.session_id, req.max_length,
-                                         num_layers=num_layers)
+            handle = self._allocate(req, num_layers, batch)
         elif handle is None:
             if req.is_replay:
                 # Replacement server rebuilding KV from the client's journal:
                 # treat the first replayed decode as a prefill
                 # (src/rpc_handler.py:187-196).
-                handle = self.arena.allocate(req.session_id, req.max_length,
-                                             num_layers=num_layers)
+                handle = self._allocate(req, num_layers, batch)
             else:
                 raise StageExecutionError(
                     f"session {req.session_id}: decode step without KV cache "
@@ -201,15 +211,6 @@ class StageExecutor:
         """Run one step of this stage for one session."""
         a, b = self._resolve_range(req)
         sub_spec, sub_params, step = self._get_subspan(a, b)
-        handle = self._session_cache(req, num_layers=max(b - a, 1))
-        if handle.k is not None and handle.k.shape[0] != max(b - a, 1):
-            raise StageExecutionError(
-                f"session {req.session_id} was allocated for "
-                f"{handle.k.shape[0]} layers but the request covers {b - a} "
-                "(a route must use a stable block range per hop)"
-            )
-        t_real = req.seq_len
-        handle.admit(t_real)
 
         x = jnp.asarray(req.hidden)
         # stage0 consumes int token ids [B, T]; later stages float hidden
@@ -219,6 +220,50 @@ class StageExecutor:
             raise StageExecutionError(
                 f"stage {self.spec.index} expects ndim={want_ndim}, got {x.shape}"
             )
+        handle = self._session_cache(req, num_layers=max(b - a, 1),
+                                     batch=x.shape[0])
+        if handle.k is not None and handle.k.shape[0] != max(b - a, 1):
+            raise StageExecutionError(
+                f"session {req.session_id} was allocated for "
+                f"{handle.k.shape[0]} layers but the request covers {b - a} "
+                "(a route must use a stable block range per hop)"
+            )
+        if req.hypo_ids is not None and not req.is_prefill:
+            # Beam reorder BEFORE the step (petals backend.py:154-158):
+            # hypothesis i continues from old KV row hypo_ids[i]. May also
+            # GROW the batch (e.g. hypo_ids=(0,)*nb expands a batch-1 prefill
+            # into nb beam rows) — re-lease the arena bytes first.
+            ids_np = np.asarray(req.hypo_ids, np.int64)
+            if ids_np.shape[0] != x.shape[0]:
+                raise StageExecutionError(
+                    f"hypo_ids has {ids_np.shape[0]} rows, batch is {x.shape[0]}"
+                )
+            old_batch = handle.k.shape[1]
+            # jnp.take clamps out-of-range indices — that would silently
+            # continue a hypothesis from the wrong KV row, so check here.
+            if ids_np.size and (ids_np.min() < 0 or ids_np.max() >= old_batch):
+                raise StageExecutionError(
+                    f"hypo_ids {tuple(req.hypo_ids)} out of range for KV "
+                    f"batch {old_batch}"
+                )
+            if x.shape[0] != old_batch:
+                try:
+                    self.arena.resize_batch(req.session_id, x.shape[0])
+                except AllocationFailed as exc:
+                    # Same taxonomy as _allocate: let the client fail over to
+                    # a replica whose arena can hold the expanded batch.
+                    raise StageExecutionError(str(exc)) from exc
+            ids = jnp.asarray(ids_np, jnp.int32)
+            handle.k = jnp.take(handle.k, ids, axis=1)
+            handle.v = jnp.take(handle.v, ids, axis=1)
+        if handle.k is not None and handle.k.shape[1] != x.shape[0]:
+            raise StageExecutionError(
+                f"session {req.session_id} holds KV for batch "
+                f"{handle.k.shape[1]}, request batch is {x.shape[0]}"
+            )
+        t_real = req.seq_len
+        handle.admit(t_real)
+
         t = x.shape[1]
         if t != t_real:
             raise StageExecutionError(f"seq_len {t_real} != tensor T {t}")
@@ -242,6 +287,19 @@ class StageExecutor:
         self.requests_served += 1
 
         if sub_spec.is_last:
+            if req.num_logprobs > 0:
+                # Beam mode: per-row top-N candidates, raw log-softmax (beam
+                # search scores, no sampling).
+                last = out[:, t_real - 1].astype(jnp.float32)  # [B, V]
+                logp = jax.nn.log_softmax(last, axis=-1)
+                vals, idx = jax.lax.top_k(logp, req.num_logprobs)
+                return StageResponse(
+                    session_id=req.session_id, cache_len=handle.cache_len,
+                    top_tokens=tuple(tuple(int(t) for t in row)
+                                     for row in np.asarray(idx)),
+                    top_logprobs=tuple(tuple(float(v) for v in row)
+                                       for row in np.asarray(vals)),
+                )
             token = self._sample(out, t_real, req)
             return StageResponse(
                 session_id=req.session_id, token_id=int(token),

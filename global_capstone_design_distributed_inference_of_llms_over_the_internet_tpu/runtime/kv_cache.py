@@ -137,10 +137,11 @@ class KVArena:
 
     # -- accounting ---------------------------------------------------------
 
-    def bytes_for(self, bucket_len: int, num_layers: Optional[int] = None) -> int:
+    def bytes_for(self, bucket_len: int, num_layers: Optional[int] = None,
+                  batch: int = 1) -> int:
         layers = self.num_layers if num_layers is None else num_layers
         per_token = 2 * layers * self.num_kv_heads * self.head_dim
-        return per_token * bucket_len * self.dtype.itemsize
+        return per_token * bucket_len * self.dtype.itemsize * batch
 
     @property
     def used_bytes(self) -> int:
@@ -160,17 +161,19 @@ class KVArena:
 
     def allocate(
         self, session_id: str, max_length: int, timeout: Optional[float] = None,
-        num_layers: Optional[int] = None,
+        num_layers: Optional[int] = None, batch: int = 1,
     ) -> KVHandle:
         """Lease cache space for a session; blocks (≤ timeout) when full.
 
         `num_layers` sizes the buffers for a sub-span execution (the
         uid-chain case — a request covering only part of the server's loaded
-        span); defaults to the arena's full layer count."""
+        span); defaults to the arena's full layer count. `batch` > 1 holds
+        one KV row per beam hypothesis (petals batched sessions,
+        ``backend.py:88-99``)."""
         timeout = self.alloc_timeout if timeout is None else timeout
         layers = self.num_layers if num_layers is None else num_layers
         bucket_len = round_to_bucket(max_length, self.buckets)
-        nbytes = self.bytes_for(bucket_len, layers)
+        nbytes = self.bytes_for(bucket_len, layers, batch)
         if nbytes > self.max_bytes:
             raise AllocationFailed(
                 f"allocation of {nbytes} bytes can never fit arena of "
@@ -199,7 +202,7 @@ class KVArena:
                 self._enqueued_bytes -= nbytes
 
         try:
-            shape = (layers, 1, bucket_len, self.num_kv_heads, self.head_dim)
+            shape = (layers, batch, bucket_len, self.num_kv_heads, self.head_dim)
             k = jnp.zeros(shape, self.dtype)
             v = jnp.zeros(shape, self.dtype)
             if self.device is not None:
@@ -225,6 +228,37 @@ class KVArena:
             self._pending.discard(session_id)
             self._handles[session_id] = handle
         return handle
+
+    def resize_batch(self, session_id: str, batch: int) -> KVHandle:
+        """Re-lease a session's bytes for a new batch size (beam expansion:
+        a batch-1 prefill growing to num_beams rows at the first reorder).
+
+        Only the ACCOUNTING changes here — the caller swaps the buffers
+        (``jnp.take`` along the batch axis materializes the new shape).
+        Growth never waits: mid-session backpressure could deadlock two
+        sessions growing against each other, so an arena too full to grow
+        fails the step immediately."""
+        with self._lock:
+            handle = self._handles.get(session_id)
+            if handle is None:
+                raise AllocationFailed(f"session {session_id} not allocated")
+            old_batch = int(handle.k.shape[1])
+            if batch == old_batch:
+                return handle
+            per_row = handle.nbytes // old_batch
+            delta = per_row * (batch - old_batch)
+            if delta > 0 and (self.max_bytes - self._used_bytes
+                              - self._enqueued_bytes) < delta:
+                raise AllocationFailed(
+                    f"arena full: cannot grow session {session_id} from "
+                    f"batch {old_batch} to {batch} (+{delta} bytes, "
+                    f"{self.bytes_left} left)"
+                )
+            self._used_bytes += delta
+            handle.nbytes += delta
+            if delta < 0:
+                self._lock.notify_all()
+            return handle
 
     def get(self, session_id: str) -> Optional[KVHandle]:
         with self._lock:

@@ -53,6 +53,13 @@ class StageRequest:
     # prompts added into the first positions of each block's input.
     train: bool = False
     prompts: Optional[jnp.ndarray] = None   # [span_layers, pre_seq, D]
+    # Beam search (petals ``backend.py:154-158`` hypo_ids semantics):
+    # hypo_ids[i] = which existing KV row hypothesis i continues from; the
+    # server reorders the session's cache BEFORE the step. num_logprobs > 0
+    # asks the final stage for per-row top-N (token, logprob) pairs instead
+    # of a sampled token — the client runs the beam bookkeeping.
+    hypo_ids: Optional[Tuple[int, ...]] = None
+    num_logprobs: int = 0
     # Push-chain route (the ``next_servers`` metadata of Petals'
     # server→server push, ``petals/server/handler.py:320-350``): the hops
     # AFTER this one. A server that produced hidden output forwards it
@@ -93,10 +100,18 @@ class StageResponse:
     hidden: Optional[jnp.ndarray] = None   # [B, T, D]
     token_id: Optional[int] = None
     cache_len: int = 0                     # server-side KV length after the step
+    # Beam mode (request.num_logprobs > 0): per batch row, the top-N
+    # continuation candidates from the final stage's logits.
+    top_tokens: Optional[Tuple[Tuple[int, ...], ...]] = None     # [B][N]
+    top_logprobs: Optional[Tuple[Tuple[float, ...], ...]] = None  # [B][N]
 
     @property
     def is_token(self) -> bool:
         return self.token_id is not None
+
+    @property
+    def is_beam(self) -> bool:
+        return self.top_tokens is not None
 
 
 def clip_generated(tokens: Sequence[int], window: int = 50) -> Tuple[int, ...]:

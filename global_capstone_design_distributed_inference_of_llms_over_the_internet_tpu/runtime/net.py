@@ -91,7 +91,14 @@ def _recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
     (hlen,) = struct.unpack("<I", _recv_exact(sock, 4))
     if hlen > MAX_FRAME:
         raise WireError(f"oversized header {hlen}")
-    header = json.loads(_recv_exact(sock, hlen))
+    try:
+        header = json.loads(_recv_exact(sock, hlen))
+    except ValueError as exc:
+        # Corrupted-but-magic-valid header: classify as a wire fault so every
+        # caller's ConnectionError taxonomy (drop + failover) applies, instead
+        # of a JSONDecodeError escaping alive()/call() and leaving the
+        # desynced socket pooled.
+        raise WireError(f"undecodable header: {exc}") from exc
     (plen,) = struct.unpack("<I", _recv_exact(sock, 4))
     if plen > MAX_FRAME:
         raise WireError(f"oversized payload {plen}")
@@ -161,6 +168,8 @@ def _request_header(req: StageRequest, tensor_meta: dict) -> dict:
         "start_block": req.start_block,
         "end_block": req.end_block,
         "next_servers": list(req.next_servers),
+        "hypo_ids": None if req.hypo_ids is None else list(req.hypo_ids),
+        "num_logprobs": req.num_logprobs,
         "tensor": tensor_meta,
     }
 
@@ -184,6 +193,9 @@ def _header_to_request(h: dict, payload: bytes) -> StageRequest:
         start_block=h.get("start_block"),
         end_block=h.get("end_block"),
         next_servers=tuple(h.get("next_servers", ())),
+        hypo_ids=(None if h.get("hypo_ids") is None
+                  else tuple(h["hypo_ids"])),
+        num_logprobs=h.get("num_logprobs", 0),
     )
 
 
@@ -336,16 +348,34 @@ class TcpStageServer(_FramedTcpServer):
         raise ConnectionError("unreachable")  # pragma: no cover
 
     def _relay_sock(self, addr: str, fresh: bool):
+        """`fresh` only runs after `_drop_relay` removed the failed socket, so
+        ANY pooled entry seen here is a newer reconnect (possibly another
+        thread's) and always usable — never displace it (the other thread may
+        be mid-frame on it, and nothing would ever close the displaced
+        socket)."""
+        del fresh  # retry safety comes from _drop_relay, not a forced redial
         with self._relay_lock:
-            if not fresh:
-                entry = self._relay_conns.get(addr)
-                if entry is not None:
-                    return entry
-            host, port = addr.rsplit(":", 1)
-            sock = socket.create_connection((host, int(port)), timeout=5.0)
-            entry = (sock, threading.Lock())
-            self._relay_conns[addr] = entry
+            entry = self._relay_conns.get(addr)
+        if entry is not None:
             return entry
+        # Connect OUTSIDE the pool lock (a slow/unresponsive host must not
+        # stall relays to every other address for the connect timeout).
+        host, port = addr.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)), timeout=5.0)
+        new_entry = (sock, threading.Lock())
+        with self._relay_lock:
+            existing = self._relay_conns.get(addr)
+            if existing is not None:
+                winner = existing  # concurrent thread reconnected first
+            else:
+                self._relay_conns[addr] = new_entry
+                winner = new_entry
+        if winner is not new_entry:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        return winner
 
     def _drop_relay(self, addr: str, sock: socket.socket) -> None:
         with self._relay_lock:
@@ -405,6 +435,13 @@ class TcpStageServer(_FramedTcpServer):
                 _send_frame(sock, {
                     "verb": "token", "session_id": resp.session_id,
                     "token_id": resp.token_id, "cache_len": resp.cache_len,
+                })
+            elif resp.is_beam:
+                _send_frame(sock, {
+                    "verb": "beam", "session_id": resp.session_id,
+                    "cache_len": resp.cache_len,
+                    "top_tokens": [list(r) for r in resp.top_tokens],
+                    "top_logprobs": [list(r) for r in resp.top_logprobs],
                 })
             elif req.next_servers:
                 # Push chain (petals handler.py:320-350): ship our output
@@ -562,10 +599,15 @@ class TcpTransport(Transport):
                 pass
 
     def alive(self, peer_id: str) -> bool:
+        """Real liveness probe, not just registry presence: dial the peer and
+        exchange an `info` round trip on a short deadline. A host whose
+        compute wedged still answers (info is served inline by the handler
+        thread); a hung/partitioned HOST does not — which is exactly the case
+        the push-chain blame heuristic needs to distinguish."""
         try:
-            self._addr(peer_id)
+            self.info(peer_id, timeout=3.0)
             return True
-        except PeerUnavailable:
+        except (PeerUnavailable, TimeoutError, ConnectionError, OSError):
             return False
 
     def call(self, peer_id: str, request: StageRequest,
@@ -603,6 +645,13 @@ class TcpTransport(Transport):
             return StageResponse(
                 session_id=header["session_id"],
                 token_id=header["token_id"], cache_len=header["cache_len"],
+            )
+        if verb == "beam":
+            return StageResponse(
+                session_id=header["session_id"],
+                cache_len=header["cache_len"],
+                top_tokens=tuple(tuple(r) for r in header["top_tokens"]),
+                top_logprobs=tuple(tuple(r) for r in header["top_logprobs"]),
             )
         if verb == "hidden":
             return StageResponse(
