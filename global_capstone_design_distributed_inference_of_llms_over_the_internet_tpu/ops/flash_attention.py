@@ -1,0 +1,224 @@
+"""Pallas TPU flash attention over the static KV cache (prefill + decode).
+
+The hot op of every stage step is attention of T query tokens against the
+session's preallocated cache (``ops.attention.cached_attention``). The pure-XLA
+version materializes the full [B, H, T, S] score tensor in fp32 — for one
+decode token over an 8k bucket that is an HBM round trip per layer that
+dwarfs the matmuls. This kernel streams the cache through VMEM in key blocks
+with an online softmax (flash attention), so scores never touch HBM and each
+K/V cache byte is read exactly once per step.
+
+Reference counterpart: the hand-optimized sdpa of ``petals/llama/block.py:
+134-141`` (manual matmul + fp32 softmax, CUDA-graphed for decode). Here the
+same op is a Pallas kernel instead of a CUDA graph: compile-once replay is
+XLA's default, and the kernel's block streaming is what the GPU version got
+from fused sdpa implementations.
+
+Design notes (why the kernel looks like this):
+  * Grid = (B, S/block_s) with the key-block axis innermost; VMEM scratch
+    (m, l, acc — one slab per kv head) carries the online-softmax state
+    across key blocks.
+  * ALL kv heads are computed inside one kernel invocation via a static
+    (unrolled) loop — so each K/V cache block is DMA'd exactly once per
+    step, not once per head, and the cache stays in its NATIVE
+    [B, S, Hkv, Dh] layout (no per-step cache transposes; the per-head read
+    is a static sublane slice).
+  * Queries ride in [B, Hkv, R, Dh] with R = T*G flattened GQA rows (the
+    tiny q transpose happens outside): R in the sublane dim keeps tile
+    padding negligible, and one [R, Dh] x [Dh, block_s] MXU matmul per
+    (head, block) serves all G group heads in a single cache pass.
+  * ``block_s`` is chosen per shape so the resident VMEM (q + double-
+    buffered K/V blocks + fp32 accumulators, with Mosaic tile padding
+    accounted) fits the ~16 MB budget; shapes that cannot fit fall back to
+    the XLA path via ``supports_flash`` (long prefills — compute-bound
+    there anyway; the kernel's win is the bandwidth-bound decode).
+  * ``cache_len`` rides in SMEM; key blocks entirely past the valid region
+    (> cache_len + T - 1) skip their FLOPs via ``pl.when`` — a short
+    session in a long bucket pays for the tokens it has, not the bucket.
+  * fp32 softmax state and fp32 MXU accumulation (``preferred_element_type``)
+    with bf16 operands — same numerics contract as the pure-JAX path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# Key-block candidates, largest first. S (the cache bucket) is always a
+# power of two >= 128 in this framework (runtime.kv_cache.DEFAULT_BUCKETS),
+# so one of these divides it when it fits VMEM.
+_BLOCK_S_CANDIDATES = (512, 256, 128)
+
+_VMEM_BUDGET = 10 * 1024 * 1024  # leave headroom under the ~16 MB/core
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _vmem_estimate(block_s: int, t: int, hkv: int, groups: int, dh: int,
+                   itemsize: int) -> int:
+    """Resident VMEM with Mosaic tile padding: trailing dims pad to
+    (sublane, 128) where sublane is 8 (fp32) / 16 (bf16)."""
+    sub = {4: 8, 2: 16, 1: 32}.get(itemsize, 8)  # min sublane per dtype
+    dh_p = _round_up(dh, 128)
+    r = t * groups
+    q_bytes = hkv * _round_up(r, sub) * dh_p * itemsize
+    kv_bytes = 2 * 2 * block_s * _round_up(hkv, sub) * dh_p * itemsize
+    acc_bytes = hkv * _round_up(r, 8) * dh_p * 4
+    ml_bytes = 2 * _round_up(hkv, 8) * _round_up(r, 128) * 4
+    score_bytes = 2 * _round_up(r, 8) * _round_up(block_s, 128) * 4
+    return q_bytes * 2 + kv_bytes + acc_bytes + ml_bytes + score_bytes
+
+
+def _pick_block_s(s: int, t: int, hkv: int, groups: int, dh: int,
+                  itemsize: int) -> Optional[int]:
+    for b in _BLOCK_S_CANDIDATES:
+        if s % b == 0 and s >= b and _vmem_estimate(
+                b, t, hkv, groups, dh, itemsize) <= _VMEM_BUDGET:
+            return b
+    return None
+
+
+def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                  *, block_s: int, t: int, hkv: int, groups: int,
+                  window: Optional[int]):
+    s_idx = pl.program_id(1)
+    num_s = pl.num_programs(1)
+    cache_len = len_ref[0]
+    r = t * groups
+    dh = q_ref.shape[-1]
+
+    @pl.when(s_idx == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Row i of the flattened [T, G] query block is token i // groups; its
+    # absolute position is cache_len + token index. Same mask for all heads.
+    row_tok = jax.lax.broadcasted_iota(jnp.int32, (r, block_s), 0) // groups
+    q_pos = cache_len + row_tok
+    col = s_idx * block_s + jax.lax.broadcasted_iota(
+        jnp.int32, (r, block_s), 1
+    )
+    allowed = col <= q_pos
+    if window is not None:
+        allowed &= col > q_pos - window
+
+    # Skip key blocks with no reachable columns: fully past the newest query
+    # (causal), or — with a sliding window — fully before the oldest visible
+    # column. Their DMA still runs (static grid) but the FLOPs don't.
+    live = (s_idx * block_s) <= (cache_len + t - 1)
+    if window is not None:
+        live &= (s_idx + 1) * block_s > cache_len - window
+
+    @pl.when(live)
+    def _block():
+        for h in range(hkv):  # static unroll: one MXU pass per kv head
+            q = q_ref[0, h]                                  # [R, Dh]
+            k = k_ref[0, :, h]                               # [block_s, Dh]
+            v = v_ref[0, :, h]
+            scores = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                                # [R, block_s]
+            scores = jnp.where(allowed, scores, NEG_INF)
+            m_prev = m_ref[h, :]                             # [R]
+            m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1))
+            p = jnp.exp(scores - m_new[:, None])             # fp32
+            alpha = jnp.exp(m_prev - m_new)                  # [R]
+            l_ref[h, :] = l_ref[h, :] * alpha + jnp.sum(p, axis=-1)
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                                # [R, Dh]
+            acc_ref[h] = acc_ref[h] * alpha[:, None] + pv
+            m_ref[h, :] = m_new
+
+    @pl.when(s_idx == num_s - 1)
+    def _finalize():
+        for h in range(hkv):
+            out = acc_ref[h] / jnp.maximum(l_ref[h, :], 1e-30)[:, None]
+            o_ref[0, h] = out.astype(o_ref.dtype)
+
+
+def flash_cached_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    cache_len: jnp.ndarray,
+    *,
+    sliding_window: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Drop-in for ``ops.attention.cached_attention`` (same contract):
+    q [B, T, H, Dh], caches [B, S, Hkv, Dh] with new keys already written,
+    returns [B, T, H, Dh]. Callers pre-check shapes with
+    ``supports_flash``."""
+    b, t, h, dh = q.shape
+    s, hkv = k_cache.shape[1], k_cache.shape[2]
+    groups = h // hkv
+    block_s = _pick_block_s(s, t, hkv, groups, dh, q.dtype.itemsize)
+    if block_s is None:
+        raise ValueError(
+            f"no key block fits shape (S={s}, T={t}, Hkv={hkv}, G={groups}, "
+            f"Dh={dh}) — check supports_flash before calling"
+        )
+
+    # [B, T, Hkv, G, Dh] -> [B, Hkv, R=T*G, Dh]: negligible copy (queries are
+    # KBs; the cache — which we do NOT transpose — is MBs).
+    r = t * groups
+    qr = (q * (dh ** -0.5)).reshape(b, t, hkv, groups, dh)
+    qr = qr.transpose(0, 2, 1, 3, 4).reshape(b, hkv, r, dh)
+    len_arr = jnp.reshape(cache_len.astype(jnp.int32), (1,))
+
+    kernel = functools.partial(
+        _flash_kernel, block_s=block_s, t=t, hkv=hkv, groups=groups,
+        window=sliding_window,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, s // block_s),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, hkv, r, dh), lambda bi, si: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, block_s, hkv, dh), lambda bi, si: (bi, si, 0, 0)),
+            pl.BlockSpec((1, block_s, hkv, dh), lambda bi, si: (bi, si, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, hkv, r, dh), lambda bi, si: (bi, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, r, dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, r), jnp.float32),      # running max m
+            pltpu.VMEM((hkv, r), jnp.float32),      # running denom l
+            pltpu.VMEM((hkv, r, dh), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(len_arr, qr, k_cache, v_cache)
+    # [B, Hkv, R, Dh] -> [B, T, H, Dh]
+    out = out.reshape(b, hkv, t, groups, dh).transpose(0, 2, 1, 3, 4)
+    return out.reshape(b, t, h, dh)
+
+
+# Below this many cache tokens the plain XLA attention wins: the score
+# tensor is small enough that fusion beats the kernel's fixed overhead
+# (measured on v5e: XLA faster at S<=512, kernel faster from ~1k up).
+_MIN_CACHE_LEN = 1024
+
+
+def supports_flash(s: int, t: int, groups: int, hkv: int = 1,
+                   dh: int = 128, itemsize: int = 2,
+                   min_cache_len: int = _MIN_CACHE_LEN) -> bool:
+    """Whether the kernel handles this shape AND is expected to beat XLA:
+    bucketed cache length of at least `min_cache_len`, and a key block whose
+    resident VMEM fits the budget."""
+    if s < min_cache_len:
+        return False
+    return _pick_block_s(s, t, hkv, groups, dh, itemsize) is not None

@@ -1,0 +1,149 @@
+"""Pallas flash attention vs the pure-XLA cached_attention oracle.
+
+Runs the kernel in interpret mode on CPU; on real TPU the same kernel
+compiles natively (ops.attention auto-dispatches there)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.ops import (
+    attention,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.ops.attention import (
+    cached_attention,
+    update_kv_cache,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.ops.flash_attention import (
+    flash_cached_attention,
+    supports_flash,
+)
+
+
+def _case(b, t, h, hkv, dh, s, cache_len, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, t, h, dh), dtype)
+    kc = jnp.zeros((b, s, hkv, dh), dtype)
+    vc = jnp.zeros((b, s, hkv, dh), dtype)
+    # realistic cache: [0, cache_len) old tokens, then T new tokens written
+    old_k = jax.random.normal(ks[1], (b, s, hkv, dh), dtype)
+    old_v = jax.random.normal(ks[2], (b, s, hkv, dh), dtype)
+    valid = (jnp.arange(s) < cache_len + t)[None, :, None, None]
+    kc = jnp.where(valid, old_k, kc)
+    vc = jnp.where(valid, old_v, vc)
+    return q, kc, vc, jnp.int32(cache_len)
+
+
+CASES = [
+    # prefill from empty
+    dict(b=1, t=16, h=4, hkv=4, dh=32, s=128, cache_len=0),
+    # decode step mid-session
+    dict(b=2, t=1, h=4, hkv=2, dh=32, s=256, cache_len=37),
+    # GQA with groups > 1, longer bucket
+    dict(b=1, t=8, h=8, hkv=2, dh=64, s=512, cache_len=100),
+    # MQA
+    dict(b=1, t=4, h=4, hkv=1, dh=32, s=128, cache_len=3),
+    # replay chunk appended mid-session
+    dict(b=1, t=32, h=4, hkv=4, dh=32, s=256, cache_len=64),
+]
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_flash_matches_reference(case):
+    q, kc, vc, cl = _case(**case)
+    ref = cached_attention(q, kc, vc, cl)
+    got = flash_cached_attention(q, kc, vc, cl, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_sliding_window():
+    q, kc, vc, cl = _case(b=1, t=8, h=4, hkv=2, dh=32, s=256, cache_len=90)
+    ref = cached_attention(q, kc, vc, cl, sliding_window=40)
+    got = flash_cached_attention(q, kc, vc, cl, sliding_window=40,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_bf16():
+    q, kc, vc, cl = _case(b=1, t=4, h=4, hkv=2, dh=64, s=128, cache_len=10,
+                          dtype=jnp.bfloat16)
+    ref = cached_attention(q, kc, vc, cl)
+    got = flash_cached_attention(q, kc, vc, cl, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        atol=3e-2, rtol=3e-2,
+    )
+
+
+def test_flash_under_jit_with_cache_update():
+    """The serving shape: jitted step writing new KV then attending."""
+    b, t, h, hkv, dh, s = 1, 1, 4, 2, 32, 256
+    q, kc, vc, _ = _case(b=t, t=t, h=h, hkv=hkv, dh=dh, s=s, cache_len=20)
+    k_new = jax.random.normal(jax.random.PRNGKey(7), (b, t, hkv, dh))
+    v_new = jax.random.normal(jax.random.PRNGKey(8), (b, t, hkv, dh))
+
+    @jax.jit
+    def step(q, kc, vc, k_new, v_new, cl):
+        kc, vc = update_kv_cache(kc, vc, k_new, v_new, cl)
+        return (flash_cached_attention(q, kc, vc, cl, interpret=True),
+                cached_attention(q, kc, vc, cl))
+
+    got, ref = step(q, kc, vc, k_new, v_new, jnp.int32(20))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_supports_flash_gates():
+    assert supports_flash(2048, 1, 2, hkv=8, dh=128)
+    assert not supports_flash(256, 1, 2, hkv=8, dh=128)  # XLA wins when small
+    assert supports_flash(256, 1, 2, hkv=8, dh=128, min_cache_len=0)
+    assert not supports_flash(1056, 1, 2)    # unbucketed cache length
+    assert not supports_flash(64, 1, 2)      # smaller than any key block
+    # long prefill: VMEM-resident slabs past the budget -> XLA path
+    assert not supports_flash(8192, 4096, 4, hkv=8, dh=128)
+
+
+def test_flash_gradients_match_xla():
+    """The training path can route through the kernel on TPU; its custom_vjp
+    must produce the XLA path's exact gradients (cache-free s == t case)."""
+    t = 128
+    q, kc, vc, cl = _case(b=1, t=t, h=4, hkv=2, dh=32, s=t, cache_len=0)
+
+    def loss_flash(q, kc, vc):
+        attention.set_flash_attention("on")
+        try:
+            return jnp.sum(cached_attention(q, kc, vc, cl) ** 2)
+        finally:
+            attention.set_flash_attention("auto")
+
+    def loss_xla(q, kc, vc):
+        attention.set_flash_attention("off")
+        try:
+            return jnp.sum(cached_attention(q, kc, vc, cl) ** 2)
+        finally:
+            attention.set_flash_attention("auto")
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, kc, vc)
+    g_xla = jax.grad(loss_xla, argnums=(0, 1, 2))(q, kc, vc)
+    for a, b_ in zip(g_flash, g_xla):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_forced_dispatch_roundtrip():
+    """attention.set_flash_attention('on') routes cached_attention through
+    the kernel (interpret off-TPU) and produces identical semantics."""
+    q, kc, vc, cl = _case(b=1, t=4, h=4, hkv=2, dh=32, s=128, cache_len=9)
+    attention.set_flash_attention("off")
+    ref = cached_attention(q, kc, vc, cl)
+    attention.set_flash_attention("on")
+    try:
+        got = cached_attention(q, kc, vc, cl)
+    finally:
+        attention.set_flash_attention("auto")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
