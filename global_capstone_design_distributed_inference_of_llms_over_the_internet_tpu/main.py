@@ -47,6 +47,10 @@ from .scheduling.registry import PlacementRegistry
 
 logger = logging.getLogger("mini_petals_tpu")
 
+# float16 runs as bfloat16: TPUs have no fp16 compute path (load_model warns).
+_DTYPE_MAP = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+              "float16": jnp.bfloat16}
+
 
 # ---------------------------------------------------------------------------
 # Tokenizer (checkpoint tokenizer, else byte-level fallback)
@@ -82,9 +86,23 @@ def load_model(args) -> Tuple[ModelConfig, dict]:
         # exponent / 7-bit mantissa vs 5/10) so an fp16 baseline will not
         # reproduce bit-for-bit.
         logger.warning("--dtype float16 runs as bfloat16 on TPU")
-    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
-             "float16": jnp.bfloat16}[args.dtype]
+    dtype = _DTYPE_MAP[args.dtype]
     if args.checkpoint:
+        if args.mode == "local":
+            import os
+
+            from .models.hf_import import config_from_checkpoint
+
+            has_st = (os.path.exists(os.path.join(
+                args.checkpoint, "model.safetensors.index.json"))
+                or os.path.exists(os.path.join(args.checkpoint,
+                                               "model.safetensors")))
+            if has_st:
+                # Per-stage weight streaming (petals from_pretrained.py:
+                # 81-128): stage servers read only their span's shards; the
+                # full model is never materialized (run_local builds a
+                # load_stage_checkpoint provider when params is None).
+                return config_from_checkpoint(args.checkpoint), None
         import torch
         from transformers import AutoModelForCausalLM
 
@@ -119,7 +137,14 @@ def run_local(args, cfg: ModelConfig, params) -> int:
 
     transport = LocalTransport()
     registry = PlacementRegistry(rng=random.Random(args.seed))
-    provider = lambda spec: slice_stage_params(cfg, params, spec)  # noqa: E731
+    if params is None:
+        # Streaming checkpoint: each stage loads only its own shards.
+        from .models.hf_import import load_stage_checkpoint
+
+        provider = lambda spec: load_stage_checkpoint(  # noqa: E731
+            args.checkpoint, cfg, spec, dtype=_DTYPE_MAP[args.dtype])
+    else:
+        provider = lambda spec: slice_stage_params(cfg, params, spec)  # noqa: E731
 
     if args.use_load_balancing:
         min_block = plan.stages[0].end

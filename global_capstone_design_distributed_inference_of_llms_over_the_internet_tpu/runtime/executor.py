@@ -77,11 +77,16 @@ class StageExecutor:
         cache_dtype=jnp.float32,
         peer_id: str = "local",
         debug_activation_checks: bool = False,
+        max_chunk_bytes: int = 256 * 1024 * 1024,
     ):
         self.cfg = cfg
         self.spec = spec
         self.params = params
         self.peer_id = peer_id
+        # Prefill chunk budget (petals ``backend.py:129-143``
+        # max_chunk_size_bytes): long prefills run as several bounded chunks
+        # over the same session cache instead of one huge activation.
+        self.max_chunk_bytes = max_chunk_bytes
         self.cache_dtype = jnp.dtype(cache_dtype)
         self.arena = arena or KVArena(
             num_layers=max(spec.num_layers, 1),
@@ -194,9 +199,12 @@ class StageExecutor:
                     f"session {req.session_id}: decode step without KV cache "
                     "and not a replay (src/rpc_handler.py:198-202 semantics)"
                 )
-        if not req.is_prefill and handle.cache_len != req.cur_len and not req.is_replay:
+        if (not req.is_prefill and handle.cache_len != req.cur_len
+                and not req.is_replay and req.start_from_position is None):
             # The reference logs and proceeds with the server's own count
-            # (src/rpc_handler.py:206-225).
+            # (src/rpc_handler.py:206-225). A rewinding step (cur_len ==
+            # start_from_position < cache_len) is NOT a mismatch — forward()
+            # adopts the client's position via handle.rewind.
             logger.warning(
                 "session %s: past-len mismatch client=%d server=%d; "
                 "trusting server", req.session_id, req.cur_len, handle.cache_len,
@@ -228,6 +236,14 @@ class StageExecutor:
                 f"{handle.k.shape[0]} layers but the request covers {b - a} "
                 "(a route must use a stable block range per hop)"
             )
+        if req.start_from_position is not None and not req.is_prefill:
+            # Session rewind (petals handler.py:163-168): shrink the valid KV
+            # prefix before this step — the client restarts generation from an
+            # earlier position.
+            try:
+                handle.rewind(req.start_from_position)
+            except ValueError as exc:
+                raise StageExecutionError(str(exc)) from exc
         if req.hypo_ids is not None and not req.is_prefill:
             # Beam reorder BEFORE the step (petals backend.py:154-158):
             # hypothesis i continues from old KV row hypo_ids[i]. May also
@@ -268,29 +284,30 @@ class StageExecutor:
         if t != t_real:
             raise StageExecutionError(f"seq_len {t_real} != tensor T {t}")
 
-        tb = round_to_bucket(t_real, SEQ_BUCKETS)
-        if handle.cache_len + tb > handle.bucket_len:
-            # Padding would make the jitted dynamic_update_slice clamp its
-            # start index (writing garbage over the newest real rows). Fall
-            # back to the exact length — one extra compile at the tail of a
-            # session beats silent cache corruption.
-            tb = t_real
-        if tb != t_real:
-            pad = ((0, 0), (0, tb - t_real)) + (((0, 0),) if x.ndim == 3 else ())
-            x = jnp.pad(x, pad)
-
-        cache_len = jnp.asarray(handle.cache_len, jnp.int32)
-        out, handle.k, handle.v = step(
-            sub_params, x, handle.k, handle.v, cache_len
-        )
-        handle.advance(t_real)
+        # Chunked prefill (petals backend.py:129-143): split an oversized
+        # request into byte-bounded chunks over the same session cache. The
+        # numerics are identical (each chunk attends causally to everything
+        # already written); what the bound buys is peak activation memory —
+        # and prefills longer than the largest jit seq bucket become possible
+        # at all. Intermediate stages concatenate chunk outputs (the next
+        # stage needs every token's hidden state); the final stage samples
+        # from the LAST chunk's logits only.
+        chunk = self._max_chunk_tokens(x.shape[0])
+        outs = []
+        off = 0
+        while off < t_real:
+            n = min(chunk, t_real - off)
+            xc = jax.lax.slice_in_dim(x, off, off + n, axis=1)
+            outs.append(self._dispatch_chunk(step, sub_params, xc, handle, n))
+            off += n
         self.requests_served += 1
 
         if sub_spec.is_last:
+            out = outs[-1]  # chunk outputs are trimmed; sample from its tail
             if req.num_logprobs > 0:
                 # Beam mode: per-row top-N candidates, raw log-softmax (beam
                 # search scores, no sampling).
-                last = out[:, t_real - 1].astype(jnp.float32)  # [B, V]
+                last = out[:, -1].astype(jnp.float32)  # [B, V]
                 logp = jax.nn.log_softmax(last, axis=-1)
                 vals, idx = jax.lax.top_k(logp, req.num_logprobs)
                 return StageResponse(
@@ -300,12 +317,12 @@ class StageExecutor:
                     top_logprobs=tuple(tuple(float(v) for v in row)
                                        for row in np.asarray(vals)),
                 )
-            token = self._sample(out, t_real, req)
+            token = self._sample(out, out.shape[1], req)
             return StageResponse(
                 session_id=req.session_id, token_id=int(token),
                 cache_len=handle.cache_len,
             )
-        out = out[:, :t_real]
+        out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
         if self.debug_activation_checks:
             # Activation-explosion guard (src/rpc_handler.py:316-319). Opt-in:
             # the float() forces a host sync per hop per token, which would
@@ -319,6 +336,40 @@ class StageExecutor:
         return StageResponse(
             session_id=req.session_id, hidden=out, cache_len=handle.cache_len
         )
+
+    def _max_chunk_tokens(self, batch: int) -> int:
+        """Tokens per prefill chunk: the byte budget over the per-token
+        activation footprint (batch x hidden x fp32 x span layers — the
+        attention-memory estimate of petals ``backend.py:146-152``), capped
+        at the largest jit seq bucket and floored at one bucket."""
+        per_token = batch * self.cfg.hidden_size * 4 * max(self.spec.num_layers, 1)
+        est = self.max_chunk_bytes // max(per_token, 1)
+        est = max(16, min(int(est), SEQ_BUCKETS[-1]))
+        # Align DOWN to a jit seq bucket: a chunk size strictly between
+        # buckets would pad every full chunk up to the next bucket — up to
+        # ~2x wasted attention/MLP work per chunk.
+        return max(b for b in SEQ_BUCKETS if b <= est)
+
+    def _dispatch_chunk(self, step, sub_params, x: jnp.ndarray,
+                        handle: KVHandle, n: int) -> jnp.ndarray:
+        """Run ONE bucket-padded jitted step of n real tokens against the
+        session cache; advances the cache and returns the TRIMMED output."""
+        tb = round_to_bucket(n, SEQ_BUCKETS)
+        if handle.cache_len + tb > handle.bucket_len:
+            # Padding would make the jitted dynamic_update_slice clamp its
+            # start index (writing garbage over the newest real rows). Fall
+            # back to the exact length — one extra compile at the tail of a
+            # session beats silent cache corruption.
+            tb = n
+        if tb != n:
+            pad = ((0, 0), (0, tb - n)) + (((0, 0),) if x.ndim == 3 else ())
+            x = jnp.pad(x, pad)
+        cache_len = jnp.asarray(handle.cache_len, jnp.int32)
+        out, handle.k, handle.v = step(
+            sub_params, x, handle.k, handle.v, cache_len
+        )
+        handle.advance(n)
+        return out[:, :n]
 
     def _sample(self, logits: jnp.ndarray, t_real: int, req: StageRequest) -> int:
         """Final-stage sampling from the last REAL token's logits, using the

@@ -53,6 +53,13 @@ class StageRequest:
     # prompts added into the first positions of each block's input.
     train: bool = False
     prompts: Optional[jnp.ndarray] = None   # [span_layers, pre_seq, D]
+    # Session rewind (the ``start_from_position`` of petals
+    # ``handler.py:163-168`` / ``block_functions.py:163-168``): before this
+    # step, shrink the session's valid KV prefix to this position — the
+    # client is re-generating from an earlier point (interactive edit /
+    # speculative rollback). Must satisfy 0 <= pos <= current cache_len and
+    # equal cur_len.
+    start_from_position: Optional[int] = None
     # Beam search (petals ``backend.py:154-158`` hypo_ids semantics):
     # hypo_ids[i] = which existing KV row hypothesis i continues from; the
     # server reorders the session's cache BEFORE the step. num_logprobs > 0

@@ -170,6 +170,7 @@ def _request_header(req: StageRequest, tensor_meta: dict) -> dict:
         "next_servers": list(req.next_servers),
         "hypo_ids": None if req.hypo_ids is None else list(req.hypo_ids),
         "num_logprobs": req.num_logprobs,
+        "start_from_position": req.start_from_position,
         "tensor": tensor_meta,
     }
 
@@ -196,6 +197,7 @@ def _header_to_request(h: dict, payload: bytes) -> StageRequest:
         hypo_ids=(None if h.get("hypo_ids") is None
                   else tuple(h["hypo_ids"])),
         num_logprobs=h.get("num_logprobs", 0),
+        start_from_position=h.get("start_from_position"),
     )
 
 
@@ -546,6 +548,23 @@ class TcpStageServer(_FramedTcpServer):
                 "requests_served": self.executor.requests_served,
                 "version": 1,
             })
+        elif verb == "reach_check":
+            # ReachabilityProtocol.rpc_check (petals reachability.py:86-164):
+            # "can YOU dial this address?" — peers answer for each other so a
+            # booting server can learn whether its advertised address is
+            # reachable from the outside before publishing it.
+            target = header.get("target", "")
+            ok = False
+            try:
+                host, port = target.rsplit(":", 1)
+                with socket.create_connection((host, int(port)), timeout=3.0) as s:
+                    _send_frame(s, {"verb": "info"})
+                    hdr, _ = _recv_frame(s)
+                    ok = hdr.get("verb") == "info"
+            except (ConnectionError, OSError, ValueError):
+                ok = False
+            _send_frame(sock, {"verb": "reach_check", "target": target,
+                               "ok": ok})
         else:
             _send_frame(sock, {"verb": "error",
                                "message": f"unknown verb {verb!r}"})
@@ -735,6 +754,21 @@ class TcpTransport(Transport):
             self._drop(peer_id)
             raise PeerUnavailable(f"peer {peer_id}: {exc}")
 
+    def reach_check(self, peer_id: str, target: str,
+                    timeout: float = 8.0) -> bool:
+        """Ask `peer_id` whether IT can dial `target` ("host:port") — the
+        client side of the reach_check verb (petals ReachabilityProtocol
+        rpc_check, reachability.py:136-150)."""
+        sock = self._connect(peer_id)
+        try:
+            sock.settimeout(timeout)
+            _send_frame(sock, {"verb": "reach_check", "target": target})
+            header, _ = _recv_frame(sock)
+            return bool(header.get("ok"))
+        except (ConnectionError, OSError) as exc:
+            self._drop(peer_id)
+            raise PeerUnavailable(f"peer {peer_id}: {exc}")
+
     def close(self) -> None:
         with self._lock:
             conns, self._conns = dict(self._conns), {}
@@ -743,6 +777,31 @@ class TcpTransport(Transport):
                 sock.close()
             except OSError:
                 pass
+
+
+def check_direct_reachability(transport: TcpTransport, registry,
+                              my_address: str, max_peers: int = 5,
+                              threshold: float = 0.5) -> Optional[bool]:
+    """Am I directly reachable at `my_address`? Ask up to `max_peers` live
+    peers to dial it back; >= `threshold` of the answers saying yes means
+    direct (petals ``check_direct_reachability``, reachability.py:55-78 —
+    same >=50%-of-<=5-peers rule). Returns None when no peer answered (a
+    single-server swarm cannot decide). A booting elastic server uses this
+    to validate its advertised address before publishing it (the reference's
+    public-maddr filtering, src/main.py:492-509)."""
+    votes = []
+    for rec in registry.live_servers():
+        if len(votes) >= max_peers:
+            break
+        if not getattr(rec, "address", None) or rec.address == my_address:
+            continue
+        try:
+            votes.append(transport.reach_check(rec.peer_id, my_address))
+        except (PeerUnavailable, TimeoutError, ConnectionError, OSError):
+            continue
+    if not votes:
+        return None
+    return sum(votes) / len(votes) >= threshold
 
 
 # ---------------------------------------------------------------------------

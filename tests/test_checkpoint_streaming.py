@@ -1,0 +1,111 @@
+"""Per-stage checkpoint streaming (petals from_pretrained.py:81-128 parity):
+stage servers load ONLY the safetensors shards containing their span.
+"""
+
+import jax
+import numpy as np
+import pytest
+import torch
+from transformers import LlamaConfig, LlamaForCausalLM
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.main import (
+    main,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.hf_import import (
+    LazyCheckpoint,
+    config_from_checkpoint,
+    convert_state_dict,
+    import_hf_model,
+    load_stage_checkpoint,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.partition import (
+    StagePlan,
+    parse_splits,
+    slice_stage_params,
+)
+
+
+@pytest.fixture(scope="module")
+def sharded_ckpt(tmp_path_factory):
+    path = tmp_path_factory.mktemp("ckpt")
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(LlamaConfig(
+        vocab_size=257, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=6, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False,
+    )).eval()
+    # tiny shard size -> many shards, so span selectivity is observable
+    hf.save_pretrained(path, max_shard_size="200KB", safe_serialization=True)
+    return str(path), hf
+
+
+def test_stage_load_equals_full_slice(sharded_ckpt):
+    path, hf = sharded_ckpt
+    cfg, full = import_hf_model(hf)
+    assert config_from_checkpoint(path).num_layers == cfg.num_layers
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("2,4"))
+    for spec in plan.stages:
+        got = load_stage_checkpoint(path, cfg, spec)
+        want = slice_stage_params(cfg, full, spec)
+        flat_g = jax.tree.leaves(got)
+        flat_w = jax.tree.leaves(want)
+        assert len(flat_g) == len(flat_w)
+        for g, w in zip(flat_g, flat_w):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=1e-6, rtol=1e-6)
+
+
+def test_middle_stage_touches_subset_of_shards(sharded_ckpt):
+    path, _ = sharded_ckpt
+    cfg = config_from_checkpoint(path)
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("2,4"))
+    mid = plan.stages[1]  # layers [2, 4): no embed, no head
+
+    sd = LazyCheckpoint(path)
+    total_shards = len(set(sd._weight_map.values()))
+    assert total_shards > 2, "fixture must produce a sharded checkpoint"
+    convert_state_dict(cfg, sd, layer_range=(mid.start, mid.end),
+                       include_embed=False, include_head=False)
+    assert sd.opened, "stage load must read shards"
+    assert len(sd.opened) < total_shards, (
+        f"middle stage read {sorted(sd.opened)} — all {total_shards} shards; "
+        "per-stage streaming must skip embed/head/other-span shards"
+    )
+
+
+def test_unprefixed_base_model_checkpoint(tmp_path):
+    """Official GPT-2-era checkpoints store keys WITHOUT the LM wrapper
+    prefix ('h.0...', 'wte...'); LazyCheckpoint must alias them."""
+    from transformers import GPT2Config, GPT2Model
+
+    torch.manual_seed(0)
+    base = GPT2Model(GPT2Config(
+        vocab_size=97, n_embd=32, n_layer=4, n_head=4, n_positions=64,
+    )).eval()
+    base.save_pretrained(tmp_path, safe_serialization=True)
+
+    sd = LazyCheckpoint(str(tmp_path))
+    assert any(k.startswith("transformer.") for k in sd._alias)
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.hf_import import (
+        config_from_hf,
+    )
+
+    cfg = config_from_hf(base.config)
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("2"))
+    for spec in plan.stages:
+        got = load_stage_checkpoint(str(tmp_path), cfg, spec)
+        assert "layers" in got
+    # middle span matches tensors read straight from the torch module
+    got = load_stage_checkpoint(str(tmp_path), cfg, plan.stages[1])
+    want = base.h[2].ln_1.weight.detach().numpy()
+    np.testing.assert_allclose(
+        np.asarray(got["layers"]["ln1"]["w"][0]), want, atol=1e-6)
+
+
+def test_cli_local_mode_streams_checkpoint(sharded_ckpt, capsys):
+    path, _ = sharded_ckpt
+    rc = main(["--mode", "local", "--splits", "2,4", "--checkpoint", path,
+               "--prompt", "hi", "--max_new_tokens", "3",
+               "--temperature", "0"])
+    assert rc == 0 or rc is None
+    assert "TTFT" in capsys.readouterr().out

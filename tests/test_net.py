@@ -282,3 +282,24 @@ def test_concurrent_sessions_through_stage_runtime():
     finally:
         srv.stop()
         reg.stop()
+
+
+def test_reach_check_and_direct_reachability(swarm):
+    """V10 parity: peers answer "can you reach X?" (rpc_check) and the
+    >=50%-of-<=5-peers direct-reachability rule aggregates the answers."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.net import (
+        check_direct_reachability,
+    )
+
+    cfg, params, client, transport, servers, reg = swarm
+    a, b = servers[0], servers[1]
+    # a can dial b's real address
+    assert transport.reach_check(a.executor.peer_id, b.address) is True
+    # nobody listens on this port
+    assert transport.reach_check(a.executor.peer_id, "127.0.0.1:1") is False
+
+    # b's address is vouched for by the other peers -> direct
+    assert check_direct_reachability(transport, client.registry,
+                                     b.address) is True
+    assert check_direct_reachability(transport, client.registry,
+                                     "127.0.0.1:1") is False
