@@ -153,6 +153,10 @@ def run_local(args, cfg: ModelConfig, params) -> int:
         for i in range(args.num_servers):
             ElasticStageServer(
                 f"server-{i}", cfg, provider, registry, transport,
+                executor_kwargs={
+                    "offload": args.use_cpu_offload,
+                    "keep_layers_resident": args.keep_layers_on_gpu,
+                },
                 num_blocks=num_blocks,
                 total_blocks=args.total_blocks or cfg.num_layers,
                 min_block=min_block,
@@ -164,7 +168,11 @@ def run_local(args, cfg: ModelConfig, params) -> int:
     else:
         for spec in plan.stages[1:]:
             peer = f"server-stage{spec.index}"
-            ex = StageExecutor(cfg, spec, provider(spec), peer_id=peer)
+            ex = StageExecutor(
+                cfg, spec, provider(spec), peer_id=peer,
+                offload=args.use_cpu_offload,
+                keep_layers_resident=args.keep_layers_on_gpu,
+            )
             transport.add_peer(peer, ex)
             registry.register(make_server_record(peer, spec))
 
@@ -338,6 +346,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--top_k", type=int, default=50)
     p.add_argument("--repetition_penalty", type=float, default=1.5)
     p.add_argument("--request_timeout", type=float, default=60.0)
+    # Host offload (reference --use_cpu_offload / --keep_layers_on_gpu,
+    # src/main.py flag table): span weights in host RAM, streamed per layer.
+    p.add_argument("--use_cpu_offload", action="store_true")
+    p.add_argument("--keep_layers_on_gpu", type=int, default=0)
     # Load balancing (reference LB flag group)
     p.add_argument("--use_load_balancing", action="store_true")
     p.add_argument("--num_blocks", type=int, default=None)
@@ -352,6 +364,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tp", type=int, default=1,
                    help="fused mode: tensor parallelism per stage")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a jax.profiler trace of the run to DIR "
+                        "(view with TensorBoard / Perfetto)")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -363,11 +378,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
     cfg, params = load_model(args)
-    if args.mode == "local":
-        return run_local(args, cfg, params)
-    if args.mode == "fused":
-        return run_fused(args, cfg, params)
-    return run_oracle(args, cfg, params)
+    run = {"local": run_local, "fused": run_fused,
+           "oracle": run_oracle}[args.mode]
+    if args.profile:
+        # SURVEY.md §5.1: the reference only had wall-clock prints; we keep
+        # its metric names AND produce a real device trace.
+        with jax.profiler.trace(args.profile):
+            return run(args, cfg, params)
+    return run(args, cfg, params)
 
 
 if __name__ == "__main__":

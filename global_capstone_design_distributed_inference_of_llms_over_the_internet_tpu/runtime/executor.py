@@ -78,6 +78,8 @@ class StageExecutor:
         peer_id: str = "local",
         debug_activation_checks: bool = False,
         max_chunk_bytes: int = 256 * 1024 * 1024,
+        offload: bool = False,
+        keep_layers_resident: int = 0,
     ):
         self.cfg = cfg
         self.spec = spec
@@ -87,6 +89,21 @@ class StageExecutor:
         # max_chunk_size_bytes): long prefills run as several bounded chunks
         # over the same session cache instead of one huge activation.
         self.max_chunk_bytes = max_chunk_bytes
+        # Host-offload layer streaming (the reference's --use_cpu_offload /
+        # --keep_layers_on_gpu, component 6): span weights live in host
+        # memory and stream through HBM one layer at a time.
+        self.offload = offload
+        self.keep_layers_resident = max(keep_layers_resident, 0)
+        if offload:
+            # Pin the executor's own copy to HOST first, so the runner's
+            # streamed layers alias host arrays and the only device-resident
+            # weights are the pinned prefix + embed/norm/head. Without this,
+            # self.params (and each cached sub_params slice) would keep the
+            # full span alive in HBM — defeating the offload entirely.
+            host = jax.devices("cpu")[0]
+            self.params = jax.tree.map(
+                lambda a: jax.device_put(a, host), params)
+            params = self.params
         self.cache_dtype = jnp.dtype(cache_dtype)
         self.arena = arena or KVArena(
             num_layers=max(spec.num_layers, 1),
@@ -143,10 +160,18 @@ class StageExecutor:
 
         cfg = self.cfg
 
-        @partial(jax.jit, donate_argnums=(2, 3))
-        def step(params, x, k_cache, v_cache, cache_len):
-            return stage_forward(cfg, sub_spec, params, x, k_cache, v_cache,
-                                 cache_len)
+        if self.offload:
+            from .offload import OffloadedSpanRunner
+
+            step = OffloadedSpanRunner(
+                cfg, sub_spec, sub_params,
+                keep_resident=self.keep_layers_resident,
+            )
+        else:
+            @partial(jax.jit, donate_argnums=(2, 3))
+            def step(params, x, k_cache, v_cache, cache_len):
+                return stage_forward(cfg, sub_spec, params, x, k_cache,
+                                     v_cache, cache_len)
 
         entry = (sub_spec, sub_params, step)
         self._subspans[key] = entry

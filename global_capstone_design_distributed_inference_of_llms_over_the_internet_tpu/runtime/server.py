@@ -74,6 +74,7 @@ class ElasticStageServer:
         bandwidth_mbps: Optional[float] = None,
         probe_throughput: bool = False,
         rng: Optional[random.Random] = None,
+        executor_kwargs: Optional[dict] = None,
     ):
         self.peer_id = peer_id
         self.cfg = cfg
@@ -88,6 +89,10 @@ class ElasticStageServer:
         self.objective = objective
         self.bandwidth_mbps = bandwidth_mbps
         self.probe_throughput = probe_throughput
+        # Extra StageExecutor knobs (offload, chunk budget, ...) applied to
+        # every span (re)load — the elastic server rebuilds its executor on
+        # rebalance, so these must persist across spans.
+        self.executor_kwargs = dict(executor_kwargs or {})
         self._rng = rng or random.Random()
         self._np_rng = np.random.default_rng(self._rng.randrange(2**31))
 
@@ -129,7 +134,9 @@ class ElasticStageServer:
             final_stage=spec.is_last,
         ))
         params = self.params_provider(spec)
-        self.executor = StageExecutor(self.cfg, spec, params, peer_id=self.peer_id)
+        self.executor = StageExecutor(self.cfg, spec, params,
+                                      peer_id=self.peer_id,
+                                      **self.executor_kwargs)
         self.spec = spec
         self.transport.add_peer(self.peer_id, self.executor)
         if self.probe_throughput:
@@ -297,13 +304,15 @@ class FixedStageServer:
         transport: LocalTransport,
         *,
         throughput: float = 1.0,
+        executor_kwargs: Optional[dict] = None,
     ):
         self.peer_id = peer_id
         self.spec = spec
         self.registry = registry
         self.transport = transport
         self.throughput = throughput
-        self.executor = StageExecutor(cfg, spec, params, peer_id=peer_id)
+        self.executor = StageExecutor(cfg, spec, params, peer_id=peer_id,
+                                      **(executor_kwargs or {}))
 
     def _record(self) -> ServerRecord:
         return ServerRecord(
