@@ -88,7 +88,7 @@ def load_model(args) -> Tuple[ModelConfig, dict]:
         logger.warning("--dtype float16 runs as bfloat16 on TPU")
     dtype = _DTYPE_MAP[args.dtype]
     if args.checkpoint:
-        if args.mode == "local":
+        if args.mode in ("local", "serve", "client"):
             import os
 
             from .models.hf_import import config_from_checkpoint
@@ -100,8 +100,8 @@ def load_model(args) -> Tuple[ModelConfig, dict]:
             if has_st:
                 # Per-stage weight streaming (petals from_pretrained.py:
                 # 81-128): stage servers read only their span's shards; the
-                # full model is never materialized (run_local builds a
-                # load_stage_checkpoint provider when params is None).
+                # full model is never materialized (run_local/run_serve/
+                # run_client load per-stage when params is None).
                 return config_from_checkpoint(args.checkpoint), None
         import torch
         from transformers import AutoModelForCausalLM
@@ -137,14 +137,7 @@ def run_local(args, cfg: ModelConfig, params) -> int:
 
     transport = LocalTransport()
     registry = PlacementRegistry(rng=random.Random(args.seed))
-    if params is None:
-        # Streaming checkpoint: each stage loads only its own shards.
-        from .models.hf_import import load_stage_checkpoint
-
-        provider = lambda spec: load_stage_checkpoint(  # noqa: E731
-            args.checkpoint, cfg, spec, dtype=_DTYPE_MAP[args.dtype])
-    else:
-        provider = lambda spec: slice_stage_params(cfg, params, spec)  # noqa: E731
+    provider = lambda spec: _stage_params(args, cfg, params, spec)  # noqa: E731
 
     if args.use_load_balancing:
         min_block = plan.stages[0].end
@@ -318,6 +311,128 @@ def _generate_and_report(args, generate_fn, cfg: ModelConfig) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Network modes: REAL multi-process swarm over TCP (reference --stage N
+# servers + DHT, src/main.py:243-278,426-555 — registry service instead of
+# Kademlia, framed TCP instead of libp2p). One process per role:
+#   --mode registry : control-plane service (the DHT bootstrap node role)
+#   --mode serve    : one stage server (--stage N picks the span)
+#   --mode client   : stage-0 client driving the remote pipeline
+# ---------------------------------------------------------------------------
+
+def _stage_params(args, cfg: ModelConfig, params, spec):
+    """Stage weights for a network role: streamed from a safetensors
+    checkpoint when possible, sliced from the loaded tree otherwise."""
+    if params is None:
+        from .models.hf_import import load_stage_checkpoint
+
+        return load_stage_checkpoint(args.checkpoint, cfg, spec,
+                                     dtype=_DTYPE_MAP[args.dtype])
+    return slice_stage_params(cfg, params, spec)
+
+
+def run_registry(args, cfg: ModelConfig, params) -> int:
+    del cfg, params
+    from .runtime.net import RegistryServer
+
+    srv = RegistryServer(host=args.host, port=args.registry_port,
+                         ttl=args.ttl)
+    srv.start()
+    # Machine-readable handshake line (the reference printed the DHT maddr
+    # for run_all.py to scrape, src/main.py:449-465).
+    print(f"REGISTRY_ADDR={srv.address}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
+    return 0
+
+
+def run_serve(args, cfg: ModelConfig, params) -> int:
+    import os
+
+    from .runtime.executor import StageExecutor as _SE
+    from .runtime.net import RemoteRegistry, TcpStageServer
+
+    splits = parse_splits(args.splits) if args.splits else None
+    plan = (StagePlan.from_splits(cfg.num_layers, splits) if splits
+            else StagePlan.even(cfg.num_layers, 4))
+    if not 1 <= args.stage < plan.num_stages:
+        raise SystemExit(
+            f"--stage must be 1..{plan.num_stages - 1} for serve mode "
+            "(stage 0 runs inside the client)")
+    spec = plan.stages[args.stage]
+
+    registry = RemoteRegistry(args.registry_addr)
+    ex = _SE(cfg, spec, _stage_params(args, cfg, params, spec),
+             peer_id=args.peer_id or f"stage{args.stage}-{os.getpid()}",
+             offload=args.use_cpu_offload,
+             keep_layers_resident=args.keep_layers_on_gpu)
+    logger.info("warming up stage %d (pre-compiling step shapes)", args.stage)
+    ex.warmup()
+    srv = TcpStageServer(ex, host=args.host, port=args.rpc_port,
+                         wire_dtype=args.wire_dtype)
+    srv.start()
+    # --public_ip overrides the advertised address (the reference's
+    # public-maddr-only advertising, component 21 / src/main.py:492-509).
+    advert = (f"{args.public_ip}:{srv.address.rsplit(':', 1)[1]}"
+              if args.public_ip else srv.address)
+    rec = make_server_record(ex.peer_id, spec)
+    rec.address = advert
+    registry.register(rec)
+    print(f"SERVING stage={args.stage} span=[{spec.start},{spec.end}) "
+          f"addr={advert} peer={ex.peer_id}", flush=True)
+    try:
+        # Heartbeat every TTL/3 (src/main.py:529-537); re-register if the
+        # registry restarted and forgot us.
+        while True:
+            time.sleep(registry.ttl / 3.0)
+            try:
+                if not registry.heartbeat(
+                        ex.peer_id,
+                        cache_tokens_left=ex.arena.tokens_left()):
+                    registry.register(rec)
+            except (ConnectionError, OSError) as exc:
+                logger.warning("heartbeat failed: %s", exc)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        try:
+            registry.unregister(ex.peer_id)
+        except Exception:
+            pass
+        srv.stop()
+    return 0
+
+
+def run_client(args, cfg: ModelConfig, params) -> int:
+    from .runtime.executor import StageExecutor as _SE
+    from .runtime.net import RemoteRegistry, TcpTransport
+
+    splits = parse_splits(args.splits) if args.splits else None
+    plan = (StagePlan.from_splits(cfg.num_layers, splits) if splits
+            else StagePlan.even(cfg.num_layers, 4))
+    registry = RemoteRegistry(args.registry_addr)
+    transport = TcpTransport(registry, wire_dtype=args.wire_dtype)
+    stage0 = _SE(cfg, plan.stages[0],
+                 _stage_params(args, cfg, params, plan.stages[0]),
+                 peer_id="client-local")
+    client = PipelineClient(
+        cfg, plan, stage0, transport, registry,
+        use_module_routing=bool(args.use_load_balancing),
+        total_blocks=args.total_blocks or cfg.num_layers,
+        request_timeout=args.request_timeout,
+        seed=args.seed,
+    )
+    try:
+        return _generate_and_report(args, client.generate, cfg)
+    finally:
+        transport.close()
+
+
+# ---------------------------------------------------------------------------
 # Argparse (reference flag table, src/main.py:776-819)
 # ---------------------------------------------------------------------------
 
@@ -326,7 +441,9 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.main",
         description="TPU-native distributed LLM inference (mini-Petals parity)",
     )
-    p.add_argument("--mode", choices=["local", "fused", "oracle"],
+    p.add_argument("--mode",
+                   choices=["local", "fused", "oracle",
+                            "registry", "serve", "client"],
                    default="local")
     p.add_argument("--model", default="gpt2",
                    help="architecture preset (gpt2[-xl], llama-3-8b, ...)")
@@ -335,8 +452,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--splits", default=None,
                    help='stage boundaries, e.g. "10,20,30" (reference format)')
     p.add_argument("--stage", type=int, default=0,
-                   help="accepted for reference-CLI parity; stages are "
-                        "in-process on TPU")
+                   help="serve mode: which pipeline stage this server runs "
+                        "(1..N; stage 0 lives in the client). Other modes "
+                        "run all stages in-process and ignore it.")
     p.add_argument("--dtype", choices=["float32", "bfloat16", "float16"],
                    default="float32")
     p.add_argument("--prompt", default="Hello, my name is")
@@ -363,6 +481,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fused mode: pipeline depth (default: #devices, <=4)")
     p.add_argument("--tp", type=int, default=1,
                    help="fused mode: tensor parallelism per stage")
+    # Network roles (reference --dht_port/--rpc_port/--public_ip surface,
+    # src/main.py:776-819, re-homed onto the TCP registry/data plane)
+    p.add_argument("--registry_addr", default="127.0.0.1:31330",
+                   help="serve/client: control-plane address (the "
+                        "--dht_initial_peers role)")
+    p.add_argument("--registry_port", type=int, default=31330,
+                   help="registry mode: listen port (the --dht_port role)")
+    p.add_argument("--rpc_port", type=int, default=0,
+                   help="serve mode: data-plane port (0 = ephemeral)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--public_ip", default=None,
+                   help="serve mode: advertise this IP instead of --host")
+    p.add_argument("--peer_id", default=None)
+    p.add_argument("--ttl", type=float, default=45.0,
+                   help="registry mode: record TTL seconds (reference 45s); "
+                        "servers learn it from heartbeat responses")
+    p.add_argument("--wire_dtype", choices=["bf16", "f32"], default="bf16",
+                   help="activation compression on the wire")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run to DIR "
@@ -377,9 +513,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    if args.mode == "registry":
+        return run_registry(args, None, None)  # no model needed
     cfg, params = load_model(args)
-    run = {"local": run_local, "fused": run_fused,
-           "oracle": run_oracle}[args.mode]
+    run = {"local": run_local, "fused": run_fused, "oracle": run_oracle,
+           "serve": run_serve, "client": run_client}[args.mode]
     if args.profile:
         # SURVEY.md §5.1: the reference only had wall-clock prints; we keep
         # its metric names AND produce a real device trace.

@@ -521,6 +521,28 @@ class StageExecutor:
     # Lifecycle
     # ------------------------------------------------------------------
 
+    def warmup(self, seq_buckets=(16, 8, 1), max_length: int = 128) -> None:
+        """Pre-compile the common (seq bucket, cache bucket) step shapes so
+        the first real request doesn't pay 30-120s of XLA compile inside the
+        client's RPC deadline (a fresh server's first prefill would
+        otherwise read as a dead peer and trigger spurious failover)."""
+        b = 1
+        cur = 0
+        for i, t in enumerate(seq_buckets):
+            if self.spec.is_first:
+                x = jnp.zeros((b, t), jnp.int32)
+            else:
+                x = jnp.zeros((b, t, self.cfg.hidden_size), jnp.float32)
+            try:
+                self.forward(StageRequest(
+                    session_id="__warmup__", hidden=x, seq_len=t,
+                    cur_len=cur, is_prefill=(i == 0),
+                    max_length=max_length))
+                cur += t
+            except Exception as exc:  # warmup must never kill a server
+                logger.warning("warmup step (T=%d) failed: %s", t, exc)
+        self.drop_session("__warmup__")
+
     def drop_session(self, session_id: str) -> None:
         self.arena.free(session_id)
 

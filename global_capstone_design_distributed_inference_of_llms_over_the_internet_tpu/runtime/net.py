@@ -836,12 +836,16 @@ class RegistryServer(_FramedTcpServer):
         verb = h.get("verb")
         if verb == "register":
             self.registry.register(_dict_to_rec(h["record"]))
-            return {"verb": "ok"}
+            # The server's TTL rides every write response so peers pace
+            # their heartbeats off the REAL expiry policy, not a client-side
+            # default (a --ttl mismatch would make records expire between
+            # heartbeats and flap the whole swarm).
+            return {"verb": "ok", "ttl": self.registry.ttl}
         if verb == "heartbeat":
             ok = self.registry.heartbeat(
                 h["peer_id"], throughput=h.get("throughput"),
                 cache_tokens_left=h.get("cache_tokens_left"))
-            return {"verb": "ok", "known": ok}
+            return {"verb": "ok", "known": ok, "ttl": self.registry.ttl}
         if verb == "unregister":
             self.registry.unregister(h["peer_id"])
             return {"verb": "ok"}
@@ -890,15 +894,21 @@ class RemoteRegistry:
 
     # -- write path ---------------------------------------------------------
 
+    def _sync_ttl(self, resp: dict) -> None:
+        if resp.get("ttl"):
+            self.ttl = float(resp["ttl"])
+
     def register(self, record: ServerRecord, ttl: Optional[float] = None) -> None:
         del ttl  # server-side TTL policy
-        self._rpc({"verb": "register", "record": _rec_to_dict(record)})
+        self._sync_ttl(
+            self._rpc({"verb": "register", "record": _rec_to_dict(record)}))
 
     def heartbeat(self, peer_id: str, throughput: Optional[float] = None,
                   cache_tokens_left: Optional[int] = None) -> bool:
         resp = self._rpc({"verb": "heartbeat", "peer_id": peer_id,
                           "throughput": throughput,
                           "cache_tokens_left": cache_tokens_left})
+        self._sync_ttl(resp)
         return bool(resp.get("known"))
 
     def unregister(self, peer_id: str) -> None:

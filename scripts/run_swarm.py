@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Launch a REAL multi-process swarm on one host: registry + stage servers +
+client, each its own OS process talking framed TCP.
+
+The reference's ``scripts/run_all.py`` (component 17) did this with log
+scraping as the readiness signal ("handlers registered" regexes,
+run_all.py:33-72) and a human as the assertion engine. Here readiness is a
+registry poll — each server's record must be live before the client starts —
+and the generation result prints at the end.
+
+Usage (tiny random-weight gpt2 by default)::
+
+    python scripts/run_swarm.py --model gpt2 --splits 4,8 \
+        --prompt "hello" --max_new_tokens 8
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+MAIN = "global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.main"
+
+
+def registry_list(addr):
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.net import (
+        RemoteRegistry,
+    )
+
+    return RemoteRegistry(addr).live_servers()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="gpt2")
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--splits", default="4,8")
+    p.add_argument("--prompt", default="hello world")
+    p.add_argument("--max_new_tokens", type=int, default=8)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--registry_port", type=int, default=31335)
+    p.add_argument("--startup_timeout", type=float, default=600.0)
+    args = p.parse_args()
+
+    num_stages = len(args.splits.split(","))  # stages 1..N (0 = client)
+    reg_addr = f"127.0.0.1:{args.registry_port}"
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    procs = []
+
+    def spawn(role_args, log_name):
+        log = open(os.path.join(REPO, f"{log_name}.log"), "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", MAIN] + role_args,
+            cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT,
+        )
+        procs.append((proc, log))
+        return proc
+
+    common = ["--model", args.model]
+    if args.checkpoint:
+        common += ["--checkpoint", args.checkpoint]
+
+    try:
+        spawn(["--mode", "registry",
+               "--registry_port", str(args.registry_port)], "registry")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                registry_list(reg_addr)
+                break
+            except OSError:
+                time.sleep(0.3)
+        else:
+            raise SystemExit("registry did not come up")
+        print(f"registry up at {reg_addr}")
+
+        for stage in range(1, num_stages + 1):
+            spawn(common + ["--mode", "serve", "--stage", str(stage),
+                            "--splits", args.splits,
+                            "--registry_addr", reg_addr],
+                  f"stage{stage}")
+
+        # Readiness = every stage's record is live in the registry
+        # (replaces the reference's log-pattern scraping).
+        deadline = time.time() + args.startup_timeout
+        while time.time() < deadline:
+            try:
+                recs = registry_list(reg_addr)
+            except OSError:
+                recs = []
+            if len(recs) >= num_stages:
+                break
+            for proc, _ in procs:
+                if proc.poll() not in (None,):
+                    raise SystemExit(
+                        f"a swarm process exited early (rc={proc.returncode})"
+                        " — see *.log")
+            time.sleep(1.0)
+        else:
+            raise SystemExit("servers did not register in time — see *.log")
+        print(f"{num_stages} stage servers registered; starting client")
+
+        rc = subprocess.call(
+            [sys.executable, "-m", MAIN] + common + [
+                "--mode", "client", "--splits", args.splits,
+                "--registry_addr", reg_addr,
+                "--prompt", args.prompt,
+                "--max_new_tokens", str(args.max_new_tokens),
+                "--temperature", str(args.temperature),
+            ],
+            cwd=REPO, env=env,
+        )
+        return rc
+    finally:
+        for proc, log in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGINT)
+        for proc, log in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            log.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

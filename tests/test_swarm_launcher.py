@@ -1,0 +1,44 @@
+"""Real multi-process swarm over TCP: registry + serve + client CLI roles,
+launched by scripts/run_swarm.py (component 17, the reference's run_all.py,
+with registry polling instead of log scraping as the readiness signal).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+import torch
+from transformers import LlamaConfig, LlamaForCausalLM
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tiny_ckpt(tmp_path_factory):
+    path = tmp_path_factory.mktemp("swarm_ckpt")
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(LlamaConfig(
+        vocab_size=300, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=6, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+    )).eval()
+    hf.save_pretrained(path, max_shard_size="200KB", safe_serialization=True)
+    return str(path)
+
+
+def test_multiprocess_swarm_generates(tiny_ckpt):
+    """registry + 2 stage-server processes + client process; generation
+    must complete and the servers must have streamed their checkpoint."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_swarm.py"),
+         "--checkpoint", tiny_ckpt, "--splits", "2,4",
+         "--prompt", "hi", "--max_new_tokens", "4",
+         "--registry_port", "31441"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=540,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "stage servers registered" in out.stdout
+    assert "TTFT" in out.stdout
