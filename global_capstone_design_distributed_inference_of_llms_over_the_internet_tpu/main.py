@@ -320,14 +320,21 @@ def _generate_and_report(args, generate_fn, cfg: ModelConfig) -> int:
 # ---------------------------------------------------------------------------
 
 def _stage_params(args, cfg: ModelConfig, params, spec):
-    """Stage weights for a network role: streamed from a safetensors
-    checkpoint when possible, sliced from the loaded tree otherwise."""
+    """Stage weights for a serving role: streamed from a safetensors
+    checkpoint when possible, sliced from the loaded tree otherwise, then
+    optionally block-quantized (--quant int8, V9 parity)."""
     if params is None:
         from .models.hf_import import load_stage_checkpoint
 
-        return load_stage_checkpoint(args.checkpoint, cfg, spec,
-                                     dtype=_DTYPE_MAP[args.dtype])
-    return slice_stage_params(cfg, params, spec)
+        sp = load_stage_checkpoint(args.checkpoint, cfg, spec,
+                                   dtype=_DTYPE_MAP[args.dtype])
+    else:
+        sp = slice_stage_params(cfg, params, spec)
+    if getattr(args, "quant", "none") != "none":
+        from .models.quant import quantize_params
+
+        sp = quantize_params(sp, args.quant)
+    return sp
 
 
 def run_registry(args, cfg: ModelConfig, params) -> int:
@@ -457,6 +464,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "run all stages in-process and ignore it.")
     p.add_argument("--dtype", choices=["float32", "bfloat16", "float16"],
                    default="float32")
+    p.add_argument("--quant", choices=["none", "int8"], default="none",
+                   help="weight-only block quantization on stage servers "
+                        "(reference V9 surface; int8 per-channel)")
     p.add_argument("--prompt", default="Hello, my name is")
     p.add_argument("--max_new_tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.7)

@@ -1,0 +1,188 @@
+"""Weight-only quantization for serving + quantization-aware block sizing.
+
+Capability parity with the reference's quantization surface (V9,
+``petals/server/block_utils.py``): the vendored server sizes and loads
+transformer blocks in NONE / INT8 / NF4 precision (``resolve_block_dtype``
+``:12-19``, byte accounting with NF4 = 4.25 bits ``get_block_size:22-53``)
+and feeds that into how many blocks a server can hold
+(``petals/server/server.py:275-326`` ``_choose_num_blocks``).
+
+TPU-native design:
+  * int8 weights with per-output-channel fp32 scales (absmax). HBM holds
+    int8; dequantization happens INSIDE the jitted step right before each
+    matmul — under ``lax.scan`` over stacked layers that means exactly one
+    layer's weights materialize at a time, so a stage's resident weight
+    memory is ~the int8 bytes.
+  * `QuantizedTensor` is a registered pytree node: quantized params slice,
+    stack, scan, and device_put exactly like plain arrays, so the executor,
+    pipeline, offload runner, and checkpoint streaming need no changes.
+  * Norms, biases, embeddings, the lm_head, and MoE routers stay in full
+    precision (the reference quantizes transformer blocks only; routers are
+    tiny and top-k placement is precision-sensitive).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .config import ModelConfig
+
+Params = Dict[str, Any]
+
+# bits per weight for sizing (block_utils.py:46: NF4 = 4.25 incl. absmax
+# block overhead). NF4 *execution* is not implemented — the sizing table
+# still covers it so placement math matches the reference's.
+QUANT_BITS = {"none": None, "int8": 8, "nf4": 4.25}
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """int8 weight + per-output-channel fp32 scale.
+
+    Layout: q has the original weight shape [..., in, out]; s broadcasts as
+    [..., 1, out] so ``q * s`` reconstructs. `dtype` records the original
+    dtype for reconstruction.
+    """
+
+    def __init__(self, q: jnp.ndarray, s: jnp.ndarray, dtype: str = "float32"):
+        self.q = q
+        self.s = s
+        self.dtype = dtype
+
+    def tree_flatten(self):
+        return (self.q, self.s), self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    def dequant(self) -> jnp.ndarray:
+        return (self.q.astype(jnp.float32) * self.s).astype(self.dtype)
+
+    def __repr__(self):
+        return f"QuantizedTensor(shape={tuple(self.q.shape)}, dtype={self.dtype})"
+
+
+def _quantize_leaf(w: jnp.ndarray) -> QuantizedTensor:
+    """Per-output-channel absmax int8: channel axis = last, reduce over the
+    input axis (-2). Works for [in, out], stacked [L, in, out], and expert
+    [E, in, out] weights alike."""
+    w32 = jnp.asarray(w, jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
+    s = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q, s.astype(jnp.float32), str(jnp.asarray(w).dtype))
+
+
+# The matmul weight names of models/transformer.py's layer schema. Norms,
+# biases, and the MoE "router" are deliberately absent (full precision).
+_MATMUL_KEYS = frozenset({"wq", "wk", "wv", "wo", "wg", "wu", "wd", "wi"})
+
+
+def quantize_layers(layers: Params, quant: str = "int8") -> Params:
+    """Quantize a `layers` subtree (stacked or single): matmul weights by
+    NAME (norm weights and biases share the ndim of stacked matmul weights,
+    so shape alone cannot distinguish them)."""
+    if quant in (None, "none"):
+        return layers
+    if quant != "int8":
+        raise NotImplementedError(
+            f"quant={quant!r}: only int8 execution is implemented "
+            "(nf4 exists for sizing parity only)"
+        )
+
+    def walk(tree, key=None):
+        if isinstance(tree, dict):
+            return {k: walk(v, k) for k, v in tree.items()}
+        if key in _MATMUL_KEYS and getattr(tree, "ndim", 0) >= 2:
+            return _quantize_leaf(tree)
+        return tree
+
+    # dict-walk instead of tree_map: the selection is name-dependent.
+    return walk(layers)
+
+
+def quantize_params(params: Params, quant: str = "int8") -> Params:
+    """Quantize a full/stage param tree: blocks only (embed/head/norm full
+    precision, matching the reference's block-scoped quantization)."""
+    out = dict(params)
+    if "layers" in params:
+        out["layers"] = quantize_layers(params["layers"], quant)
+    return out
+
+
+def dequant_tree(tree: Params) -> Params:
+    """Materialize full-precision weights for any QuantizedTensor leaves.
+    Identity (and free) for unquantized trees; under jit+scan this runs per
+    layer, so only one layer's weights exist dequantized at a time."""
+    return jax.tree.map(
+        lambda x: x.dequant() if isinstance(x, QuantizedTensor) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, QuantizedTensor),
+    )
+
+
+def is_quantized(tree: Params) -> bool:
+    return any(isinstance(x, QuantizedTensor) for x in jax.tree.leaves(
+        tree, is_leaf=lambda x: isinstance(x, QuantizedTensor)))
+
+
+# ---------------------------------------------------------------------------
+# Quantization-aware sizing (block_utils.get_block_size:22-53) and server
+# auto-capacity (server.py _choose_num_blocks:275-326)
+# ---------------------------------------------------------------------------
+
+def params_per_block(cfg: ModelConfig) -> int:
+    """Parameter count of ONE transformer block (no embed/head)."""
+    d, i = cfg.hidden_size, cfg.intermediate_size
+    h, hkv, dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    attn = d * h * dh + 2 * d * hkv * dh + h * dh * d
+    if cfg.use_bias:
+        attn += h * dh + 2 * hkv * dh + d
+    if cfg.is_moe:
+        mlp = cfg.num_experts * 3 * d * i + d * cfg.num_experts
+    elif cfg.mlp == "swiglu":
+        mlp = 3 * d * i
+    else:
+        mlp = 2 * d * i + (i + d if cfg.use_bias else 0)
+    norms = (4 if cfg.norm == "layernorm" else 2) * d
+    return attn + mlp + norms
+
+
+def block_bytes(cfg: ModelConfig, dtype_bytes: int = 2,
+                quant: str = "none") -> int:
+    """Bytes one block occupies resident (quant-aware, V9 parity)."""
+    if quant not in QUANT_BITS:
+        raise ValueError(f"unknown quant mode {quant!r} "
+                         f"(expected one of {sorted(QUANT_BITS)})")
+    n = params_per_block(cfg)
+    bits = QUANT_BITS[quant]
+    if bits is None:  # "none": full precision
+        return n * dtype_bytes
+    return int(n * bits / 8)
+
+
+def choose_num_blocks(
+    cfg: ModelConfig,
+    memory_budget_bytes: int,
+    *,
+    dtype_bytes: int = 2,
+    quant: str = "none",
+    attn_cache_bytes: int = 0,
+    reserve_fraction: float = 0.05,
+) -> int:
+    """How many blocks fit a device budget after the KV-cache arena and a
+    safety reserve — the server auto-capacity rule
+    (``petals/server/server.py:275-326``, which budgets weights + attention
+    cache + autograd headroom out of free GPU memory)."""
+    usable = int(memory_budget_bytes * (1.0 - reserve_fraction))
+    usable -= attn_cache_bytes
+    per = block_bytes(cfg, dtype_bytes, quant)
+    return max(1, min(cfg.num_layers, usable // max(per, 1)))

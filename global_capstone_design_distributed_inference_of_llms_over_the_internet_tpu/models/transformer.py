@@ -274,6 +274,12 @@ def layer_forward(
     rope: (cos, sin) from `make_rope`, or None for learned-position models.
     k_cache=None selects the cache-free training path (see `_attention`).
     """
+    from .quant import dequant_tree
+
+    # int8-serving hook: materialize full-precision weights for any
+    # QuantizedTensor leaves. Inside lax.scan this runs per layer, so only
+    # one layer's dequantized weights exist at a time (models/quant.py).
+    p = dequant_tree(p)
     attn_out, k_cache, v_cache = _attention(
         cfg, p["attn"], _norm(cfg, p["ln1"], x), rope, k_cache, v_cache,
         cache_len, tp_axis,

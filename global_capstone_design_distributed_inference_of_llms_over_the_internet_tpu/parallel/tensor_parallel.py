@@ -78,6 +78,18 @@ def stage_param_specs(cfg: ModelConfig, params: Params, axis: str = "tp") -> Par
     it keeps logits replicated for sampling). The single source of truth for
     both placement (`shard_stage_params`) and shard_map in_specs
     (`make_tp_stage_fn`)."""
+    from ..models.quant import is_quantized
+
+    if is_quantized(params):
+        # QuantizedTensor's q/s leaves would miss the name-keyed TP tables
+        # and silently replicate — each rank would then compute the FULL
+        # projection and the closing psum would multiply results by tp.
+        # Fail loudly instead of corrupting logits.
+        raise NotImplementedError(
+            "tensor parallelism over int8-quantized params is not "
+            "supported; shard full-precision params (quantize per shard "
+            "afterwards if needed)"
+        )
     spec_for = layer_partition_specs(cfg, axis)
 
     def f(path, _leaf):

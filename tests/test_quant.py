@@ -1,0 +1,175 @@
+"""int8 weight-only serving (V9 parity) + quantization-aware block sizing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+    init_params,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.partition import (
+    StagePlan,
+    parse_splits,
+    slice_stage_params,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.quant import (
+    QuantizedTensor,
+    block_bytes,
+    choose_num_blocks,
+    dequant_tree,
+    is_quantized,
+    quantize_params,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.ops.sampling import (
+    SamplingParams,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.client import (
+    PipelineClient,
+    make_server_record,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.executor import (
+    StageExecutor,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.transport import (
+    LocalTransport,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.scheduling.registry import (
+    PlacementRegistry,
+)
+
+from test_runtime_pipeline import oracle_generate, tiny_cfg
+from test_tensor_parallel import tiny_cfg as tp_tiny_cfg
+
+
+def test_roundtrip_error_bounded():
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    qp = quantize_params(params)
+    assert is_quantized(qp["layers"]) and not is_quantized(params["layers"])
+    deq = dequant_tree(qp["layers"])
+    for orig, got in zip(jax.tree.leaves(params["layers"]),
+                         jax.tree.leaves(deq)):
+        scale = float(jnp.max(jnp.abs(orig)))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(orig),
+                                   atol=scale / 100)
+
+
+def test_quantized_pipeline_matches_dequantized_oracle():
+    """Serving with int8 weights must be token-identical to serving with
+    those SAME weights explicitly dequantized — the quantization error is in
+    the weights, never in the execution path."""
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("2,4,6"))
+    qfull = quantize_params({"layers": params["layers"]})
+    deq_params = dict(params, layers=dequant_tree(qfull["layers"]))
+
+    import random as _random
+
+    transport = LocalTransport()
+    registry = PlacementRegistry(rng=_random.Random(0))
+    for spec in plan.stages[1:]:
+        sp = quantize_params(slice_stage_params(cfg, params, spec))
+        peer = f"q-s{spec.index}"
+        transport.add_peer(peer, StageExecutor(cfg, spec, sp, peer_id=peer))
+        registry.register(make_server_record(peer, spec))
+    stage0 = StageExecutor(
+        cfg, plan.stages[0],
+        quantize_params(slice_stage_params(cfg, params, plan.stages[0])),
+        peer_id="client-local")
+    client = PipelineClient(cfg, plan, stage0, transport, registry,
+                            settle_seconds=0.0)
+    res = client.generate([5, 9, 23, 7, 81], max_new_tokens=6,
+                          sampling=SamplingParams(temperature=0.0))
+    ref = oracle_generate(cfg, deq_params, [5, 9, 23, 7, 81], 6,
+                          SamplingParams(temperature=0.0))
+    assert res.tokens == ref
+
+
+def test_moe_router_stays_full_precision():
+    cfg = tp_tiny_cfg("mixtral")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    qp = quantize_params(params)
+    router = qp["layers"]["mlp"]["router"]
+    assert not isinstance(router, QuantizedTensor)
+    assert isinstance(qp["layers"]["mlp"]["wg"], QuantizedTensor)
+    assert isinstance(qp["layers"]["attn"]["wq"], QuantizedTensor)
+    # quantized mixtral forward runs end-to-end
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+        full_forward,
+        init_kv_cache,
+    )
+
+    kc, vc = init_kv_cache(cfg, cfg.num_layers, 1, 16)
+    ids = jnp.asarray([[1, 2, 3]], jnp.int32)
+    logits, _, _ = full_forward(cfg, qp, ids, kc, vc, jnp.int32(0))
+    assert logits.shape == (1, 3, cfg.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_quantized_offload_combo():
+    """QuantizedTensor leaves survive host pinning + per-layer streaming."""
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("2,6"))
+    spec = plan.stages[1]
+    sp = quantize_params(slice_stage_params(cfg, params, spec))
+    res = StageExecutor(cfg, spec, sp, peer_id="q")
+    off = StageExecutor(cfg, spec, sp, peer_id="qo", offload=True,
+                        keep_layers_resident=1)
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.messages import (
+        StageRequest,
+    )
+
+    hid = np.random.default_rng(0).standard_normal(
+        (1, 6, cfg.hidden_size)).astype(np.float32)
+    a = res.forward(StageRequest(session_id="s", hidden=jnp.asarray(hid),
+                                 seq_len=6, cur_len=0, is_prefill=True,
+                                 max_length=16))
+    b = off.forward(StageRequest(session_id="s", hidden=jnp.asarray(hid),
+                                 seq_len=6, cur_len=0, is_prefill=True,
+                                 max_length=16))
+    np.testing.assert_allclose(np.asarray(b.hidden), np.asarray(a.hidden),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_block_sizing_and_auto_capacity():
+    cfg = tiny_cfg()
+    full = block_bytes(cfg, dtype_bytes=2)
+    i8 = block_bytes(cfg, quant="int8")
+    nf4 = block_bytes(cfg, quant="nf4")
+    assert nf4 < i8 < full
+    budget = full * 4
+    assert choose_num_blocks(cfg, budget, dtype_bytes=2) <= 4
+    assert choose_num_blocks(cfg, budget, quant="int8") >= \
+        choose_num_blocks(cfg, budget, dtype_bytes=2)
+    # clamps: never below 1, never above the model depth
+    assert choose_num_blocks(cfg, 1) == 1
+    assert choose_num_blocks(cfg, 1 << 40) == cfg.num_layers
+
+
+def test_tp_over_quantized_params_rejected():
+    """TP sharding tables are name-keyed; quantized leaves would silently
+    replicate and double-count through the psum — must fail loudly."""
+    import pytest
+    from jax.sharding import Mesh
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.partition import (
+        StagePlan as SP,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.parallel.tensor_parallel import (
+        stage_param_specs,
+    )
+
+    cfg = tp_tiny_cfg("llama")
+    params = quantize_params(init_params(jax.random.PRNGKey(0), cfg))
+    with pytest.raises(NotImplementedError):
+        stage_param_specs(cfg, params)
+
+
+def test_block_bytes_rejects_unknown_mode():
+    import pytest
+
+    cfg = tiny_cfg()
+    with pytest.raises(ValueError):
+        block_bytes(cfg, quant="int4")
