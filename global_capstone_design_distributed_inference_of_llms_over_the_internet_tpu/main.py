@@ -363,6 +363,8 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
     from .runtime.executor import StageExecutor as _SE
     from .runtime.net import RemoteRegistry, TcpStageServer
 
+    if args.use_load_balancing:
+        return _run_serve_elastic(args, cfg, params)
     splits = parse_splits(args.splits) if args.splits else None
     plan = (StagePlan.from_splits(cfg.num_layers, splits) if splits
             else StagePlan.even(cfg.num_layers, 4))
@@ -410,6 +412,66 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
             registry.unregister(ex.peer_id)
         except Exception:
             pass
+        srv.stop()
+    return 0
+
+
+def _run_serve_elastic(args, cfg: ModelConfig, params) -> int:
+    """Elastic (load-balancing) stage server over TCP: the span is CHOSEN
+    from live swarm coverage (rule 1), re-chosen on imbalance (rule 2), and
+    the executor is swapped in place on the listening socket — the
+    reference's LB servers were network servers too
+    (src/main.py:281-423,558-772)."""
+    import os
+
+    from .runtime.net import RemoteRegistry, TcpStageServer
+    from .runtime.server import ElasticStageServer
+
+    peer = args.peer_id or f"lb-{os.getpid()}"
+    registry = RemoteRegistry(args.registry_addr)
+    srv = TcpStageServer(None, host=args.host, port=args.rpc_port,
+                         wire_dtype=args.wire_dtype, peer_id=peer)
+    srv.start()
+    advert = (f"{args.public_ip}:{srv.address.rsplit(':', 1)[1]}"
+              if args.public_ip else srv.address)
+
+    class _Membership:
+        """LocalTransport's membership surface, backed by the live TCP
+        socket: add_peer swaps the served executor, remove_peer blanks it
+        (requests during a re-span get a retryable stage error)."""
+
+        def add_peer(self, peer_id, executor):
+            srv.executor = executor
+
+        def remove_peer(self, peer_id):
+            srv.executor = None
+
+    splits = parse_splits(args.splits) if args.splits else None
+    min_block = splits[0] if splits else 0  # client-local prefix floor
+    total = args.total_blocks or cfg.num_layers
+    num_blocks = args.num_blocks or max(1, (total - min_block) // 3)
+    es = ElasticStageServer(
+        peer, cfg, lambda spec: _stage_params(args, cfg, params, spec),
+        registry, _Membership(),
+        num_blocks=num_blocks, total_blocks=total, min_block=min_block,
+        balance_quality=args.balance_quality,
+        mean_balance_check_period=args.mean_balance_check_period,
+        bandwidth_mbps=args.network_bandwidth_mbps,
+        executor_kwargs={"offload": args.use_cpu_offload,
+                         "keep_layers_resident": args.keep_layers_on_gpu},
+        advertise_address=advert, warmup=True,
+        rng=random.Random(args.seed + os.getpid()),
+    )
+    es.start()
+    print(f"SERVING elastic span=[{es.spec.start},{es.spec.end}) "
+          f"addr={advert} peer={peer}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        es.stop()
         srv.stop()
     return 0
 

@@ -297,12 +297,21 @@ class TcpStageServer(_FramedTcpServer):
     process boundary. Without one, compute runs on the handler thread
     (single-client deployments)."""
 
-    def __init__(self, executor: StageExecutor, host: str = "127.0.0.1",
+    def __init__(self, executor: Optional[StageExecutor],
+                 host: str = "127.0.0.1",
                  port: int = 0, wire_dtype: str = "bf16",
                  runtime: Optional["StageRuntime"] = None,
                  compute_timeout: float = 120.0,
-                 owns_runtime: bool = True):
+                 owns_runtime: bool = True,
+                 peer_id: Optional[str] = None):
+        # May be swapped at runtime (elastic servers re-span in place) or
+        # None during a re-span window — requests then get a retryable
+        # stage error and clients fail over / retry.
         self.executor = executor
+        # Stable identity independent of the (swappable) executor: error
+        # frames must carry a real peer id even mid-re-span, or push-chain
+        # clients blacklist a placeholder and never route around us.
+        self.peer_id = peer_id or (executor.peer_id if executor else None)
         self.wire_dtype = wire_dtype
         self.runtime = runtime
         self.compute_timeout = compute_timeout
@@ -393,9 +402,10 @@ class TcpStageServer(_FramedTcpServer):
         super().start()
         if self.runtime is not None and self.owns_runtime:
             self.runtime.start()
-        logger.info("stage server %s on %s (span [%d, %d))",
-                    self.executor.peer_id, self.address,
-                    self.executor.spec.start, self.executor.spec.end)
+        if self.executor is not None:
+            logger.info("stage server %s on %s (span [%d, %d))",
+                        self.executor.peer_id, self.address,
+                        self.executor.spec.start, self.executor.spec.end)
 
     def stop(self) -> None:
         super().stop()
@@ -411,10 +421,25 @@ class TcpStageServer(_FramedTcpServer):
 
     def _dispatch(self, sock, header: dict, payload: bytes) -> None:
         verb = header.get("verb")
+        if verb == "reach_check":
+            # Socket-only probe — needs no executor, so a re-spanning server
+            # still answers reachability votes for its peers.
+            self._reach_check(sock, header)
+            return
+        # Snapshot: the elastic rebalance thread may null/swap self.executor
+        # at any moment; every later access in this request must see ONE
+        # consistent executor (a mid-request swap would otherwise surface as
+        # an AttributeError in a kind-less — non-retryable — error frame).
+        ex = self.executor
+        if ex is None:
+            _send_frame(sock, {"verb": "error", "kind": "stage",
+                               "peer": self.peer_id or "?",
+                               "message": "server is re-spanning"})
+            return
         if verb == "forward":
             req = _header_to_request(header, payload)
             try:
-                resp = self._compute("inference", self.executor.forward, req,
+                resp = self._compute("inference", ex.forward, req,
                                      size=req.seq_len)
             # All three map to kind="stage": the client converts that to
             # StageExecutionError, which is in its retryable taxonomy
@@ -425,11 +450,11 @@ class TcpStageServer(_FramedTcpServer):
             except (StageExecutionError, TaskRejected) as exc:
                 _send_frame(sock, {"verb": "error", "message": str(exc),
                                    "kind": "stage",
-                                   "peer": self.executor.peer_id})
+                                   "peer": ex.peer_id})
                 return
             except TimeoutError:
                 _send_frame(sock, {"verb": "error", "kind": "stage",
-                                   "peer": self.executor.peer_id,
+                                   "peer": ex.peer_id,
                                    "message": f"stage compute timed out after "
                                               f"{self.compute_timeout:.0f}s"})
                 return
@@ -490,7 +515,7 @@ class TcpStageServer(_FramedTcpServer):
                         start_block=header.get("start_block"),
                         end_block=header.get("end_block"),
                     )
-                    resp = self._compute("forward", self.executor.train_forward,
+                    resp = self._compute("forward", ex.train_forward,
                                          req, size=req.seq_len)
                     arr = np.asarray(resp.hidden)
                     meta, body = _encode_tensor(arr, self.wire_dtype)
@@ -509,7 +534,7 @@ class TcpStageServer(_FramedTcpServer):
                         start_block=header.get("start_block"),
                         end_block=header.get("end_block"),
                     )
-                    bresp = self._compute("backward", self.executor.backward,
+                    bresp = self._compute("backward", ex.backward,
                                           breq, size=breq.seq_len)
                     arrs = [np.asarray(bresp.grad_input)]
                     if bresp.grad_prompts is not None:
@@ -532,7 +557,7 @@ class TcpStageServer(_FramedTcpServer):
             # still stepping its KV buffers would null them mid-step and
             # corrupt the arena's byte accounting.
             try:
-                self._compute("inference", self.executor.drop_session,
+                self._compute("inference", ex.drop_session,
                               header["session_id"])
             except (StageExecutionError, TaskRejected, TimeoutError) as exc:
                 _send_frame(sock, {"verb": "error", "message": str(exc),
@@ -540,34 +565,37 @@ class TcpStageServer(_FramedTcpServer):
                 return
             _send_frame(sock, {"verb": "ok"})
         elif verb == "info":
-            spec = self.executor.spec
+            spec = ex.spec
             _send_frame(sock, {
-                "verb": "info", "peer_id": self.executor.peer_id,
+                "verb": "info", "peer_id": ex.peer_id,
                 "start_block": spec.start, "end_block": spec.end,
-                "cache_tokens_left": self.executor.arena.tokens_left(),
-                "requests_served": self.executor.requests_served,
+                "cache_tokens_left": ex.arena.tokens_left(),
+                "requests_served": ex.requests_served,
                 "version": 1,
             })
-        elif verb == "reach_check":
-            # ReachabilityProtocol.rpc_check (petals reachability.py:86-164):
-            # "can YOU dial this address?" — peers answer for each other so a
-            # booting server can learn whether its advertised address is
-            # reachable from the outside before publishing it.
-            target = header.get("target", "")
-            ok = False
-            try:
-                host, port = target.rsplit(":", 1)
-                with socket.create_connection((host, int(port)), timeout=3.0) as s:
-                    _send_frame(s, {"verb": "info"})
-                    hdr, _ = _recv_frame(s)
-                    ok = hdr.get("verb") == "info"
-            except (ConnectionError, OSError, ValueError):
-                ok = False
-            _send_frame(sock, {"verb": "reach_check", "target": target,
-                               "ok": ok})
         else:
             _send_frame(sock, {"verb": "error",
                                "message": f"unknown verb {verb!r}"})
+
+    def _reach_check(self, sock, header: dict) -> None:
+        """ReachabilityProtocol.rpc_check (petals reachability.py:86-164):
+        "can YOU dial this address?" — peers answer for each other so a
+        booting server can learn whether its advertised address is
+        reachable from the outside before publishing it."""
+        target = header.get("target", "")
+        ok = False
+        try:
+            host, port = target.rsplit(":", 1)
+            with socket.create_connection((host, int(port)), timeout=3.0) as s:
+                _send_frame(s, {"verb": "info"})
+                hdr, _ = _recv_frame(s)
+                # A re-spanning peer answers with a stage-error frame — it
+                # is still REACHABLE (the probe is about connectivity).
+                ok = hdr.get("verb") in ("info", "error")
+        except (ConnectionError, OSError, ValueError):
+            ok = False
+        _send_frame(sock, {"verb": "reach_check", "target": target,
+                           "ok": ok})
 
 
 # ---------------------------------------------------------------------------

@@ -75,6 +75,8 @@ class ElasticStageServer:
         probe_throughput: bool = False,
         rng: Optional[random.Random] = None,
         executor_kwargs: Optional[dict] = None,
+        advertise_address: Optional[str] = None,
+        warmup: bool = False,
     ):
         self.peer_id = peer_id
         self.cfg = cfg
@@ -93,6 +95,11 @@ class ElasticStageServer:
         # every span (re)load — the elastic server rebuilds its executor on
         # rebalance, so these must persist across spans.
         self.executor_kwargs = dict(executor_kwargs or {})
+        # Network deployments: the data-plane address to publish in records
+        # (None for in-process transports) and whether to pre-compile the hot
+        # step shapes on every span (re)load before going ONLINE.
+        self.advertise_address = advertise_address
+        self.warmup = warmup
         self._rng = rng or random.Random()
         self._np_rng = np.random.default_rng(self._rng.randrange(2**31))
 
@@ -137,6 +144,8 @@ class ElasticStageServer:
         self.executor = StageExecutor(self.cfg, spec, params,
                                       peer_id=self.peer_id,
                                       **self.executor_kwargs)
+        if self.warmup:
+            self.executor.warmup()
         self.spec = spec
         self.transport.add_peer(self.peer_id, self.executor)
         if self.probe_throughput:
@@ -157,6 +166,7 @@ class ElasticStageServer:
             cache_tokens_left=(
                 self.executor.arena.tokens_left() if self.executor else None
             ),
+            address=self.advertise_address,
         )
 
     def _probe(self) -> float:

@@ -44,6 +44,13 @@ def main():
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--registry_port", type=int, default=31335)
     p.add_argument("--startup_timeout", type=float, default=600.0)
+    p.add_argument("--lb", action="store_true",
+                   help="elastic load-balancing servers (spans chosen from "
+                        "swarm coverage) instead of fixed --splits spans")
+    p.add_argument("--num_servers", type=int, default=2,
+                   help="--lb: how many elastic servers to spawn")
+    p.add_argument("--num_blocks", type=int, default=None,
+                   help="--lb: blocks per elastic server")
     args = p.parse_args()
 
     num_stages = len(args.splits.split(","))  # stages 1..N (0 = client)
@@ -79,21 +86,29 @@ def main():
             raise SystemExit("registry did not come up")
         print(f"registry up at {reg_addr}")
 
-        for stage in range(1, num_stages + 1):
-            spawn(common + ["--mode", "serve", "--stage", str(stage),
-                            "--splits", args.splits,
-                            "--registry_addr", reg_addr],
-                  f"stage{stage}")
+        num_servers = args.num_servers if args.lb else num_stages
+        for i in range(1, num_servers + 1):
+            role = ["--mode", "serve", "--splits", args.splits,
+                    "--registry_addr", reg_addr]
+            if args.lb:
+                role += ["--use_load_balancing", "--peer_id", f"lb{i}"]
+                if args.num_blocks:
+                    role += ["--num_blocks", str(args.num_blocks)]
+            else:
+                role += ["--stage", str(i)]
+            spawn(common + role, f"stage{i}")
 
-        # Readiness = every stage's record is live in the registry
-        # (replaces the reference's log-pattern scraping).
+        # Readiness = every server's record is live AND ONLINE in the
+        # registry (elastic servers register JOINING first while they
+        # compile — replaces the reference's log-pattern scraping).
         deadline = time.time() + args.startup_timeout
         while time.time() < deadline:
             try:
-                recs = registry_list(reg_addr)
+                recs = [r for r in registry_list(reg_addr)
+                        if str(r.state) == "online"]
             except OSError:
                 recs = []
-            if len(recs) >= num_stages:
+            if len(recs) >= num_servers:
                 break
             for proc, _ in procs:
                 if proc.poll() not in (None,):
@@ -103,18 +118,17 @@ def main():
             time.sleep(1.0)
         else:
             raise SystemExit("servers did not register in time — see *.log")
-        print(f"{num_stages} stage servers registered; starting client")
+        print(f"{num_servers} stage servers registered; starting client")
 
-        rc = subprocess.call(
-            [sys.executable, "-m", MAIN] + common + [
-                "--mode", "client", "--splits", args.splits,
-                "--registry_addr", reg_addr,
-                "--prompt", args.prompt,
-                "--max_new_tokens", str(args.max_new_tokens),
-                "--temperature", str(args.temperature),
-            ],
-            cwd=REPO, env=env,
-        )
+        client_args = ["--mode", "client", "--splits", args.splits,
+                       "--registry_addr", reg_addr,
+                       "--prompt", args.prompt,
+                       "--max_new_tokens", str(args.max_new_tokens),
+                       "--temperature", str(args.temperature)]
+        if args.lb:
+            client_args += ["--use_load_balancing"]
+        rc = subprocess.call([sys.executable, "-m", MAIN] + common
+                             + client_args, cwd=REPO, env=env)
         return rc
     finally:
         for proc, log in procs:

@@ -42,3 +42,22 @@ def test_multiprocess_swarm_generates(tiny_ckpt):
     assert out.returncode == 0, out.stdout + out.stderr
     assert "stage servers registered" in out.stdout
     assert "TTFT" in out.stdout
+
+
+def test_multiprocess_elastic_lb_swarm(tiny_ckpt):
+    """Elastic LB servers over TCP: each server process CHOOSES its span
+    from swarm coverage (rule 1), the module-routing client generates
+    through them (the reference's LB servers were network servers,
+    src/main.py:281-423)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_swarm.py"),
+         "--checkpoint", tiny_ckpt, "--splits", "2",
+         "--lb", "--num_servers", "2", "--num_blocks", "2",
+         "--prompt", "hi", "--max_new_tokens", "4",
+         "--registry_port", "31445"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=540,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "TTFT" in out.stdout
