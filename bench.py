@@ -3,10 +3,20 @@
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
 
 Workload: gpt2 (124M, the reference's primary config — README.md:46-53) in
-bfloat16, batch 8, 64-token prefill, 64 fused greedy decode steps where the
-whole (forward + argmax + KV update) step is one donated jitted program — the
-XLA counterpart of the reference's CUDA-graph decode path
-(petals/llama/cuda_graphs.py).
+bfloat16, batch 8, 64-token prefill, 64 fused greedy decode steps.
+
+Methodology notes (both matter on tunneled/async backends):
+  * The WHOLE decode runs as ONE jitted lax.scan program — the TPU-idiomatic
+    equivalent of the reference's CUDA-graph decode path
+    (petals/llama/cuda_graphs.py): zero per-step host round trips, XLA
+    replays one compiled while-loop.
+  * Timing is closed by FETCHING the final tokens to the host
+    (np.asarray), not block_until_ready(): on tunneled backends
+    block_until_ready can return before device completion, which silently
+    turns the measurement into dispatch throughput. The final tokens
+    data-depend on every step, so their arrival bounds real completion.
+  * Best of 3 runs with DISTINCT prompts per run (identical inputs can be
+    served from caches on some backends).
 
 The reference publishes no numbers (BASELINE.md), so vs_baseline compares
 against the previous round's own recording (BENCH_r*.json) when present,
@@ -22,6 +32,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
     full_forward,
@@ -33,19 +44,16 @@ from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.
 BATCH = 8
 PREFILL = 64
 DECODE_STEPS = 64
-# Cache bucket: smallest power-of-two holding prefill + decode + warmup
-# token. This is the runtime's own bucket policy (runtime/kv_cache.py
-# DEFAULT_BUCKETS) and it matters on TPU: an unaligned cache length (e.g.
-# the tight 129) forces off-tile layouts in the attention ops — measured
-# ~2.3x slower end-to-end on v5e than the 256 bucket.
+# Cache bucket: smallest power-of-two holding prefill + decode — matches
+# the runtime's bucket policy (runtime/kv_cache.py DEFAULT_BUCKETS), so the
+# bench exercises the same shapes serving does.
 MAX_LEN = 256
-assert PREFILL + DECODE_STEPS + 1 <= MAX_LEN
+assert PREFILL + DECODE_STEPS <= MAX_LEN
 
 
 def main():
     cfg = get_config("gpt2")
     params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.bfloat16)
-    kc, vc = init_kv_cache(cfg, cfg.num_layers, BATCH, MAX_LEN, dtype=jnp.bfloat16)
 
     @partial(jax.jit, donate_argnums=(2, 3))
     def prefill(params, ids, kc, vc):
@@ -53,27 +61,33 @@ def main():
         return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32), kc, vc
 
     @partial(jax.jit, donate_argnums=(2, 3))
-    def decode(params, tok, kc, vc, cache_len):
-        logits, kc, vc = full_forward(cfg, params, tok[:, None], kc, vc, cache_len)
-        return jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32), kc, vc
+    def decode_all(params, tok, kc, vc):
+        def body(carry, _):
+            tok, kc, vc, cl = carry
+            logits, kc, vc = full_forward(cfg, params, tok[:, None], kc, vc, cl)
+            tok = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            return (tok, kc, vc, cl + 1), tok
 
-    ids = jax.random.randint(jax.random.PRNGKey(1), (BATCH, PREFILL), 0,
-                             cfg.vocab_size, jnp.int32)
-    tok, kc, vc = prefill(params, ids, kc, vc)
+        (tok, kc, vc, _), toks = jax.lax.scan(
+            body, (tok, kc, vc, jnp.int32(PREFILL)), None,
+            length=DECODE_STEPS)
+        return toks, kc, vc
 
-    # warmup decode (compile)
-    tok_w, kc, vc = decode(params, tok, kc, vc, jnp.int32(PREFILL))
-    tok_w.block_until_ready()
+    def run(seed: int) -> float:
+        ids = jax.random.randint(jax.random.PRNGKey(seed),
+                                 (BATCH, PREFILL), 0, cfg.vocab_size,
+                                 jnp.int32)
+        kc, vc = init_kv_cache(cfg, cfg.num_layers, BATCH, MAX_LEN,
+                               dtype=jnp.bfloat16)
+        tok, kc, vc = prefill(params, ids, kc, vc)
+        np.asarray(tok)  # hard sync: prefill fully done before the clock
+        t0 = time.perf_counter()
+        toks, kc, vc = decode_all(params, tok, kc, vc)
+        np.asarray(toks[-1])  # hard sync: final step's tokens on host
+        return time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    cache_len = PREFILL + 1
-    tok = tok_w
-    for i in range(DECODE_STEPS):
-        tok, kc, vc = decode(params, tok, kc, vc, jnp.int32(cache_len))
-        cache_len += 1
-    tok.block_until_ready()
-    dt = time.perf_counter() - t0
-
+    run(999)  # compile
+    dt = min(run(s) for s in (1, 2, 3))
     tokens_per_s = BATCH * DECODE_STEPS / dt
 
     prev = None

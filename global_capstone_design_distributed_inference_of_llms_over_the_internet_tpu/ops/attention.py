@@ -22,9 +22,14 @@ import numpy as np
 NEG_INF = -1e30
 
 # Flash-attention dispatch: "auto" uses the Pallas kernel on TPU whenever the
-# shape qualifies (bucketed cache), pure XLA elsewhere; "on" forces it
-# (interpret-mode on CPU — for tests); "off" forces the pure-XLA path.
-_FLASH_MODE = "auto"
+# shape qualifies (bucketed cache >= _MIN_CACHE_LEN), pure XLA elsewhere;
+# "on" forces it (interpret-mode on CPU — for tests); "off" forces the
+# pure-XLA path. DEFAULT IS OFF: measured honestly (hard host-fetch sync,
+# fused-scan decode, v5e) XLA's fused attention beat the kernel at every
+# cache length tried (e.g. 3.5 vs 6.7 ms/step at S=8192 on a 0.5B model) —
+# the kernel's unfused custom-call boundary costs more than its streaming
+# saves on this generation. Revisit per hardware with set_flash_attention.
+_FLASH_MODE = "off"
 
 
 def set_flash_attention(mode: str) -> None:

@@ -1,7 +1,8 @@
 """Pallas flash attention vs the pure-XLA cached_attention oracle.
 
 Runs the kernel in interpret mode on CPU; on real TPU the same kernel
-compiles natively (ops.attention auto-dispatches there)."""
+compiles natively (opt-in via ops.attention.set_flash_attention — XLA's
+fused attention measured faster on v5e, so dispatch defaults off)."""
 
 import jax
 import jax.numpy as jnp
@@ -19,6 +20,19 @@ from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.
     flash_cached_attention,
     supports_flash,
 )
+
+import contextlib
+
+
+@contextlib.contextmanager
+def flash_mode(mode):
+    """Set the dispatch mode, restoring whatever was active before."""
+    prev = attention._FLASH_MODE
+    attention.set_flash_attention(mode)
+    try:
+        yield
+    finally:
+        attention.set_flash_attention(prev)
 
 
 def _case(b, t, h, hkv, dh, s, cache_len, dtype=jnp.float32, seed=0):
@@ -114,18 +128,12 @@ def test_flash_gradients_match_xla():
     q, kc, vc, cl = _case(b=1, t=t, h=4, hkv=2, dh=32, s=t, cache_len=0)
 
     def loss_flash(q, kc, vc):
-        attention.set_flash_attention("on")
-        try:
+        with flash_mode("on"):
             return jnp.sum(cached_attention(q, kc, vc, cl) ** 2)
-        finally:
-            attention.set_flash_attention("auto")
 
     def loss_xla(q, kc, vc):
-        attention.set_flash_attention("off")
-        try:
+        with flash_mode("off"):
             return jnp.sum(cached_attention(q, kc, vc, cl) ** 2)
-        finally:
-            attention.set_flash_attention("auto")
 
     g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, kc, vc)
     g_xla = jax.grad(loss_xla, argnums=(0, 1, 2))(q, kc, vc)
@@ -138,12 +146,9 @@ def test_forced_dispatch_roundtrip():
     """attention.set_flash_attention('on') routes cached_attention through
     the kernel (interpret off-TPU) and produces identical semantics."""
     q, kc, vc, cl = _case(b=1, t=4, h=4, hkv=2, dh=32, s=128, cache_len=9)
-    attention.set_flash_attention("off")
-    ref = cached_attention(q, kc, vc, cl)
-    attention.set_flash_attention("on")
-    try:
+    with flash_mode("off"):
+        ref = cached_attention(q, kc, vc, cl)
+    with flash_mode("on"):
         got = cached_attention(q, kc, vc, cl)
-    finally:
-        attention.set_flash_attention("auto")
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
