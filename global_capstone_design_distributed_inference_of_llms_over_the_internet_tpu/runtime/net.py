@@ -38,6 +38,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 import jax.numpy as jnp
@@ -438,6 +439,7 @@ class TcpStageServer(_FramedTcpServer):
             return
         if verb == "forward":
             req = _header_to_request(header, payload)
+            t_req = time.monotonic()
             try:
                 resp = self._compute("inference", ex.forward, req,
                                      size=req.seq_len)
@@ -499,6 +501,22 @@ class TcpStageServer(_FramedTcpServer):
                     "verb": "hidden", "session_id": resp.session_id,
                     "cache_len": resp.cache_len, "tensor": meta,
                 }, body)
+            # Structured per-request record (petals _log_request,
+            # handler.py:549-573): prefills at INFO, per-token decode steps
+            # at DEBUG so steady-state serving doesn't flood logs. Logged
+            # AFTER the response is encoded+sent: JAX dispatch is async, so
+            # only then has the device work for hidden-returning stages
+            # actually materialized — ms covers real compute, not dispatch.
+            logger.log(
+                logging.INFO if req.is_prefill else logging.DEBUG,
+                "req peer=%s session=%s kind=%s span=[%s,%s) T=%d B=%d "
+                "replay=%d ms=%.1f",
+                ex.peer_id, req.session_id,
+                "prefill" if req.is_prefill else "decode",
+                req.start_block, req.end_block, req.seq_len,
+                req.hidden.shape[0], int(req.is_replay),
+                (time.monotonic() - t_req) * 1e3,
+            )
         elif verb in ("train_forward", "backward"):
             # QoS via the pool kinds: inference outranks both training verbs
             # (DummyTaskPrioritizer semantics, petals/server/task_prioritizer.py).
