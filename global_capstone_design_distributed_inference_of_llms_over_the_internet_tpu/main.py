@@ -174,6 +174,7 @@ def run_local(args, cfg: ModelConfig, params) -> int:
     client = PipelineClient(
         cfg, plan, stage0, transport, registry,
         use_module_routing=bool(args.use_load_balancing),
+        route_by_latency=args.route_by_latency,
         total_blocks=args.total_blocks or cfg.num_layers,
         request_timeout=args.request_timeout,
         seed=args.seed,
@@ -393,16 +394,33 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
     registry.register(rec)
     print(f"SERVING stage={args.stage} span=[{spec.start},{spec.end}) "
           f"addr={advert} peer={ex.peer_id}", flush=True)
+    # Next-hop RTT probe (petals/server/server.py:760-767): a TcpTransport
+    # resolves peers via the registry, so pings hit the real data-plane wire.
+    from .runtime.net import TcpTransport as _TT
+    from .runtime.server import measure_next_server_rtts as _rtts
+
+    ping_tx = _TT(registry, wire_dtype=args.wire_dtype)
     try:
         # Heartbeat every TTL/3 (src/main.py:529-537); re-register if the
         # registry restarted and forgot us.
+        rtts = None
         while True:
             time.sleep(registry.ttl / 3.0)
             try:
+                # Refresh first with last beat's RTTs, then measure — a slow
+                # ping sweep must not delay the TTL refresh past expiry.
+                rec.next_server_rtts = rtts
                 if not registry.heartbeat(
                         ex.peer_id,
-                        cache_tokens_left=ex.arena.tokens_left()):
+                        cache_tokens_left=ex.arena.tokens_left(),
+                        next_server_rtts=rtts):
                     registry.register(rec)
+                # {} is published as-is: it RETRACTS stale RTTs (None would
+                # mean "no update" and pin dead-link measurements forever).
+                rtts = (None if spec.is_last else _rtts(
+                    registry, lambda r: ping_tx.ping(r.peer_id),
+                    ex.peer_id, spec.end,
+                    budget_s=registry.ttl / 6.0))
             except (ConnectionError, OSError) as exc:
                 logger.warning("heartbeat failed: %s", exc)
     except KeyboardInterrupt:
@@ -450,9 +468,13 @@ def _run_serve_elastic(args, cfg: ModelConfig, params) -> int:
     min_block = splits[0] if splits else 0  # client-local prefix floor
     total = args.total_blocks or cfg.num_layers
     num_blocks = args.num_blocks or max(1, (total - min_block) // 3)
+    from .runtime.net import TcpTransport as _TT
+
+    ping_tx = _TT(registry, wire_dtype=args.wire_dtype)
     es = ElasticStageServer(
         peer, cfg, lambda spec: _stage_params(args, cfg, params, spec),
         registry, _Membership(),
+        pinger=lambda rec: ping_tx.ping(rec.peer_id),
         num_blocks=num_blocks, total_blocks=total, min_block=min_block,
         balance_quality=args.balance_quality,
         mean_balance_check_period=args.mean_balance_check_period,
@@ -491,6 +513,7 @@ def run_client(args, cfg: ModelConfig, params) -> int:
     client = PipelineClient(
         cfg, plan, stage0, transport, registry,
         use_module_routing=bool(args.use_load_balancing),
+        route_by_latency=args.route_by_latency,
         total_blocks=args.total_blocks or cfg.num_layers,
         request_timeout=args.request_timeout,
         seed=args.seed,
@@ -542,6 +565,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--keep_layers_on_gpu", type=int, default=0)
     # Load balancing (reference LB flag group)
     p.add_argument("--use_load_balancing", action="store_true")
+    p.add_argument("--route_by_latency", action="store_true",
+                   help="module routing minimizes estimated end-to-end step "
+                        "latency (server-published next-hop RTTs + client "
+                        "pings) instead of greedy max-coverage")
     p.add_argument("--num_blocks", type=int, default=None)
     p.add_argument("--total_blocks", type=int, default=None)
     p.add_argument("--num_servers", type=int, default=3)

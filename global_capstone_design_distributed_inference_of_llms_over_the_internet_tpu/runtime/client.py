@@ -133,6 +133,7 @@ class PipelineClient:
         registry: PlacementRegistry,
         *,
         use_module_routing: bool = False,
+        route_by_latency: bool = False,
         use_push_chain: bool = False,
         total_blocks: Optional[int] = None,
         request_timeout: float = 60.0,
@@ -146,6 +147,7 @@ class PipelineClient:
         self.transport = transport
         self.registry = registry
         self.use_module_routing = use_module_routing
+        self.route_by_latency = route_by_latency
         self.use_push_chain = use_push_chain
         self.total_blocks = total_blocks or cfg.num_layers
         self.request_timeout = request_timeout
@@ -164,6 +166,12 @@ class PipelineClient:
         # advertised cache capacity.
         self._session_peers: Dict[str, set] = {}
         self._route: Optional[List[Hop]] = None
+        # peer -> (rtt_s, measured_at): client-side ping cache for the
+        # latency planner's first hop. Route recomputation runs on the
+        # RECOVERY path, where serially re-pinging dead candidates (multi-
+        # second timeouts each) would multiply failover latency.
+        self._ping_cache: Dict[str, Tuple[float, float]] = {}
+        self.ping_cache_ttl = 30.0
 
         # Metrics mirroring RpcTransport.last_prefill_stage_times /
         # decode_stage_history (src/rpc_transport.py:98-103).
@@ -188,11 +196,73 @@ class PipelineClient:
             hops.append(Hop(key, peer, spec.start, spec.end, spec.is_last))
         return hops
 
+    def _ping_candidates(self, peer_ids: Sequence[str]) -> Dict[str, float]:
+        """Concurrent pings with a freshness cache (ping_cache_ttl seconds).
+        Unreachable peers are simply absent (the planner charges its default
+        RTT); failed pings are not cached so a recovering peer is re-probed."""
+        now = time.monotonic()
+        out: Dict[str, float] = {}
+        to_ping: List[str] = []
+        for pid in peer_ids:
+            cached = self._ping_cache.get(pid)
+            if cached is not None and now - cached[1] < self.ping_cache_ttl:
+                out[pid] = cached[0]
+            else:
+                to_ping.append(pid)
+        if to_ping:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(8, len(to_ping))) as pool:
+                for pid, rtt in zip(to_ping,
+                                    pool.map(self.transport.ping, to_ping)):
+                    if rtt is not None:
+                        out[pid] = rtt
+                        self._ping_cache[pid] = (rtt, now)
+        return out
+
+    def _compute_latency_route(self) -> Optional[List[Hop]]:
+        """Latency-aware module routing: Dijkstra over block coverage using
+        server-published next-hop RTTs + the client's own first-hop pings
+        (scheduling.routing; the upstream-Petals ping-aware route choice the
+        greedy router approximates). Returns None when the planner finds no
+        final-stage-terminated coverage — caller falls back to greedy."""
+        from ..scheduling.routing import plan_min_latency_route
+
+        start = self.plan.stages[0].end
+        exclude = set()
+        for peers in self.failed_peers.values():
+            exclude |= peers
+        records = self.registry.live_servers()
+        # Client-side pings for first-hop candidates only (the rest of the
+        # route uses server-published RTTs). Pings run CONCURRENTLY and
+        # recent measurements are reused — failover triggers a route refresh
+        # exactly when candidates are likely dead, and serial multi-second
+        # ping timeouts there would multiply recovery latency.
+        cands = [rec.peer_id for rec in records
+                 if rec.start_block <= start < rec.end_block
+                 and rec.peer_id not in exclude]
+        client_rtts = self._ping_candidates(cands)
+        planned = plan_min_latency_route(
+            records, start, self.total_blocks,
+            client_rtts=client_rtts, exclude=tuple(exclude))
+        if planned is None:
+            return None
+        hops = [Hop(f"blocks{h.entry}", h.record.peer_id, h.entry, h.end,
+                    h.end >= self.total_blocks)
+                for h in planned]
+        return hops
+
     def _compute_module_route(self) -> List[Hop]:
         """Greedy block-coverage routing (``src/rpc_transport.py:393-493``):
         cover [stage0_end, total_blocks) hop by hop, each hop the candidate
         with max end_block (tie-break throughput), loop-guarded, final hop
         must serve the final stage."""
+        if self.route_by_latency:
+            hops = self._compute_latency_route()
+            if hops is not None:
+                return hops
+            logger.warning("latency planner found no route; "
+                           "falling back to greedy coverage routing")
         start = self.plan.stages[0].end
         hops: List[Hop] = []
         covered = start

@@ -675,6 +675,17 @@ class TcpTransport(Transport):
         except (PeerUnavailable, TimeoutError, ConnectionError, OSError):
             return False
 
+    def ping(self, peer_id: str) -> Optional[float]:
+        """Real wire RTT: time one `info` round trip (a fresh exchange on the
+        pooled connection — dial cost is paid once, so steady-state pings
+        measure the link, not the handshake)."""
+        try:
+            t0 = time.perf_counter()
+            self.info(peer_id, timeout=3.0)
+            return time.perf_counter() - t0
+        except (PeerUnavailable, TimeoutError, ConnectionError, OSError):
+            return None
+
     def call(self, peer_id: str, request: StageRequest,
              timeout: Optional[float] = None) -> StageResponse:
         sock = self._connect(peer_id)
@@ -855,7 +866,8 @@ def check_direct_reachability(transport: TcpTransport, registry,
 # ---------------------------------------------------------------------------
 
 _REC_FIELDS = ("peer_id", "start_block", "end_block", "throughput", "state",
-               "final_stage", "stage_index", "cache_tokens_left", "address")
+               "final_stage", "stage_index", "cache_tokens_left", "address",
+               "next_server_rtts")
 
 
 def _rec_to_dict(rec: ServerRecord) -> dict:
@@ -890,14 +902,19 @@ class RegistryServer(_FramedTcpServer):
         if verb == "heartbeat":
             ok = self.registry.heartbeat(
                 h["peer_id"], throughput=h.get("throughput"),
-                cache_tokens_left=h.get("cache_tokens_left"))
+                cache_tokens_left=h.get("cache_tokens_left"),
+                next_server_rtts=h.get("next_server_rtts"))
             return {"verb": "ok", "known": ok, "ttl": self.registry.ttl}
         if verb == "unregister":
             self.registry.unregister(h["peer_id"])
             return {"verb": "ok"}
         if verb == "list":
+            # age_s rides along so clients can reconstruct freshness ordering:
+            # raw `timestamp` is time.monotonic(), meaningless across hosts.
+            now = time.monotonic()
             return {"verb": "records",
-                    "records": [_rec_to_dict(r)
+                    "records": [dict(_rec_to_dict(r),
+                                     age_s=max(0.0, now - r.timestamp))
                                 for r in self.registry.live_servers()]}
         return {"verb": "error", "message": f"unknown verb {verb!r}"}
 
@@ -950,10 +967,12 @@ class RemoteRegistry:
             self._rpc({"verb": "register", "record": _rec_to_dict(record)}))
 
     def heartbeat(self, peer_id: str, throughput: Optional[float] = None,
-                  cache_tokens_left: Optional[int] = None) -> bool:
+                  cache_tokens_left: Optional[int] = None,
+                  next_server_rtts: Optional[Dict[str, float]] = None) -> bool:
         resp = self._rpc({"verb": "heartbeat", "peer_id": peer_id,
                           "throughput": throughput,
-                          "cache_tokens_left": cache_tokens_left})
+                          "cache_tokens_left": cache_tokens_left,
+                          "next_server_rtts": next_server_rtts})
         self._sync_ttl(resp)
         return bool(resp.get("known"))
 
@@ -973,8 +992,14 @@ class RemoteRegistry:
         import random as _random
 
         fresh = PlacementRegistry(rng=_random.Random(0))
+        now = time.monotonic()
         for d in resp.get("records", []):
-            fresh.register(_dict_to_rec(d))
+            rec = _dict_to_rec(d)
+            fresh.register(rec)
+            # Restore true freshness from the server-reported age (register()
+            # stamps "now"): newest-first ordering in discovery and next-hop
+            # ping candidate selection depends on it.
+            rec.timestamp = now - float(d.get("age_s") or 0.0)
         self._local = fresh
 
     def live_servers(self):

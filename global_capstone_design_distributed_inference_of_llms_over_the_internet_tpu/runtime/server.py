@@ -26,6 +26,7 @@ from __future__ import annotations
 import logging
 import random
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Sequence
 
 import numpy as np
@@ -40,12 +41,61 @@ from ..scheduling.registry import (
 )
 from ..scheduling.throughput import get_server_throughput
 from .executor import StageExecutor
-from .transport import LocalTransport
+from .transport import LocalTransport, Transport
 
 logger = logging.getLogger(__name__)
 
 Params = Dict[str, Any]
 ParamsProvider = Callable[[StageSpec], Params]
+
+# How many likely next-hop peers a server pings per heartbeat
+# (petals/server/server.py:760-767 pings the servers of its successor block).
+MAX_PINGED_NEXT_SERVERS = 5
+
+
+def measure_next_server_rtts(
+    registry: PlacementRegistry,
+    ping: Callable[[ServerRecord], Optional[float]],
+    peer_id: str,
+    end_block: int,
+    max_peers: int = MAX_PINGED_NEXT_SERVERS,
+    budget_s: Optional[float] = None,
+) -> Dict[str, float]:
+    """Ping the live servers able to serve ``end_block`` (this server's likely
+    next hops) and return {peer_id: rtt_seconds}. Unreachable peers are
+    omitted — absence, not infinity, so the route planner applies its default
+    penalty instead of hard-excluding a peer that merely dropped one ping.
+    ``budget_s`` caps the whole sweep (checked between pings): sweeps run
+    inside heartbeat loops, and a pile-up of timing-out pings must not
+    stretch the inter-refresh gap past the registry TTL."""
+    cands = [
+        r for r in registry.live_servers()
+        if r.peer_id != peer_id
+        and r.start_block <= end_block < r.end_block
+    ]
+    cands.sort(key=lambda r: r.timestamp, reverse=True)
+    deadline = None if budget_s is None else time.monotonic() + budget_s
+    rtts: Dict[str, float] = {}
+    for rec in cands[:max_peers]:
+        if deadline is not None and time.monotonic() >= deadline:
+            break
+        rtt = ping(rec)
+        if rtt is not None:
+            rtts[rec.peer_id] = rtt
+    return rtts
+
+
+def _pinger_from_transport(
+    transport,
+) -> Optional[Callable[[ServerRecord], Optional[float]]]:
+    """A pinger built on the transport's `ping`, or None when the transport
+    never overrode the base method (base returns None = unsupported) — so
+    servers on ping-less transports publish no RTT table at all instead of
+    eternally-empty sweeps."""
+    tping = getattr(type(transport), "ping", None)
+    if tping is None or tping is Transport.ping:
+        return None
+    return lambda rec: transport.ping(rec.peer_id)
 
 
 class ElasticStageServer:
@@ -77,6 +127,7 @@ class ElasticStageServer:
         executor_kwargs: Optional[dict] = None,
         advertise_address: Optional[str] = None,
         warmup: bool = False,
+        pinger: Optional[Callable[[ServerRecord], Optional[float]]] = None,
     ):
         self.peer_id = peer_id
         self.cfg = cfg
@@ -102,6 +153,14 @@ class ElasticStageServer:
         self.warmup = warmup
         self._rng = rng or random.Random()
         self._np_rng = np.random.default_rng(self._rng.randrange(2**31))
+
+        # RTT probe to a peer; defaults to the transport's ping when the
+        # transport actually implements one (LocalTransport / TcpTransport),
+        # else disabled. TCP serve mode injects a registry-resolving
+        # TcpTransport pinger.
+        self._pinger = (pinger if pinger is not None
+                        else _pinger_from_transport(transport))
+        self.next_server_rtts: Dict[str, float] = {}
 
         self.executor: Optional[StageExecutor] = None
         self.spec: Optional[StageSpec] = None
@@ -167,6 +226,7 @@ class ElasticStageServer:
                 self.executor.arena.tokens_left() if self.executor else None
             ),
             address=self.advertise_address,
+            next_server_rtts=self._published_rtts(),
         )
 
     def _probe(self) -> float:
@@ -212,13 +272,43 @@ class ElasticStageServer:
         heartbeat would leave it serving but invisible forever."""
         if self.spec is None:
             return
+        # TTL refresh FIRST, carrying the PREVIOUS beat's RTTs: a slow ping
+        # sweep must never delay the refresh past record expiry. Staleness is
+        # bounded by one beat (TTL/3); the sweep itself is budgeted (TTL/6)
+        # so the inter-refresh gap stays well under the TTL even when every
+        # ping times out.
         if not self.registry.heartbeat(
             self.peer_id, throughput=self.throughput,
             cache_tokens_left=(
                 self.executor.arena.tokens_left() if self.executor else None
             ),
+            next_server_rtts=self._published_rtts(),
         ):
             self.registry.register(self._record())
+        self.ping_next_servers()
+
+    def _published_rtts(self) -> Optional[Dict[str, float]]:
+        """What to advertise: None when pinging is unsupported or there is no
+        next hop (nothing to say — the registry treats None as 'no update');
+        otherwise the latest sweep AS IS, because an EMPTY sweep must be
+        published to retract stale RTTs after links degrade."""
+        if (self._pinger is None or self.spec is None or self.spec.is_last
+                or self.spec.end >= self.total_blocks):
+            return None
+        return dict(self.next_server_rtts)
+
+    def ping_next_servers(self) -> Dict[str, float]:
+        """Measure RTT to likely next-hop peers (the announcer's
+        ``_ping_next_servers``, ``petals/server/server.py:760-767``). Final
+        stages have no next hop; a server without a pinger publishes none."""
+        if (self.spec is None or self.spec.is_last or self._pinger is None
+                or self.spec.end >= self.total_blocks):
+            self.next_server_rtts = {}
+        else:
+            self.next_server_rtts = measure_next_server_rtts(
+                self.registry, self._pinger, self.peer_id, self.spec.end,
+                budget_s=self.registry.ttl / 6.0)
+        return self.next_server_rtts
 
     def maybe_rebalance(self) -> bool:
         """Rule 2; on True, tear down and re-span (``src/main.py:405-416``).
@@ -315,12 +405,18 @@ class FixedStageServer:
         *,
         throughput: float = 1.0,
         executor_kwargs: Optional[dict] = None,
+        total_blocks: Optional[int] = None,
+        pinger: Optional[Callable[[ServerRecord], Optional[float]]] = None,
     ):
         self.peer_id = peer_id
         self.spec = spec
         self.registry = registry
         self.transport = transport
         self.throughput = throughput
+        self.total_blocks = total_blocks or cfg.num_layers
+        self._pinger = (pinger if pinger is not None
+                        else _pinger_from_transport(transport))
+        self.next_server_rtts: Dict[str, float] = {}
         self.executor = StageExecutor(cfg, spec, params, peer_id=peer_id,
                                       **(executor_kwargs or {}))
 
@@ -330,18 +426,40 @@ class FixedStageServer:
             end_block=self.spec.end, throughput=self.throughput,
             state=ServerState.ONLINE, final_stage=self.spec.is_last,
             stage_index=self.spec.index,
+            next_server_rtts=self._published_rtts(),
         )
 
     def start_serving(self) -> None:
         self.transport.add_peer(self.peer_id, self.executor)
         self.registry.register(self._record())
 
+    def _published_rtts(self) -> Optional[Dict[str, float]]:
+        # See ElasticStageServer._published_rtts: None = nothing to say,
+        # {} = retract stale measurements.
+        if (self._pinger is None or self.spec.is_last
+                or self.spec.end >= self.total_blocks):
+            return None
+        return dict(self.next_server_rtts)
+
+    def ping_next_servers(self) -> Dict[str, float]:
+        if (self.spec.is_last or self._pinger is None
+                or self.spec.end >= self.total_blocks):
+            self.next_server_rtts = {}
+        else:
+            self.next_server_rtts = measure_next_server_rtts(
+                self.registry, self._pinger, self.peer_id, self.spec.end,
+                budget_s=self.registry.ttl / 6.0)
+        return self.next_server_rtts
+
     def heartbeat_once(self) -> None:
+        # Refresh first, measure after (see ElasticStageServer.heartbeat_once).
         if not self.registry.heartbeat(
             self.peer_id, throughput=self.throughput,
             cache_tokens_left=self.executor.arena.tokens_left(),
+            next_server_rtts=self._published_rtts(),
         ):
             self.registry.register(self._record())  # self-heal after expiry
+        self.ping_next_servers()
 
     def shutdown(self) -> None:
         self.transport.remove_peer(self.peer_id)

@@ -77,6 +77,16 @@ class Transport(abc.ABC):
         leaks server sessions forever (``src/rpc_handler.py:70`` has no
         eviction); servers should also run `KVArena.evict_idle` as backstop."""
 
+    def ping(self, peer_id: str) -> Optional[float]:
+        """Measured RTT to a peer in seconds, or None if unreachable — the
+        signal servers publish for likely next hops
+        (``petals/server/server.py:760-767``) and clients feed to the
+        latency-aware route planner. Base: None (unsupported) — a transport
+        must override with a REAL round trip; timing a local bookkeeping call
+        would advertise every link as free."""
+        del peer_id
+        return None
+
 
 class LocalTransport(Transport):
     """In-process transport over a dict of stage executors.
@@ -99,6 +109,10 @@ class LocalTransport(Transport):
         self.calls: int = 0
         # Optional per-call tap for tracing/tests: (peer_id, request) -> None
         self.on_call: Optional[Callable[[str, StageRequest], None]] = None
+        # Synthetic link latencies for tests ("peer" or "a->b" keys), read by
+        # ping()/measure_next_server_rtts — the in-process stand-in for real
+        # wire RTTs.
+        self.rtts: Dict[str, float] = {}
 
     # -- membership ---------------------------------------------------------
 
@@ -142,6 +156,12 @@ class LocalTransport(Transport):
     def alive(self, peer_id: str) -> bool:
         with self._lock:
             return peer_id in self._peers and not self._dead.get(peer_id, True)
+
+    def ping(self, peer_id: str) -> Optional[float]:
+        if not self.alive(peer_id):
+            return None
+        with self._lock:
+            return self.rtts.get(peer_id, 0.0)
 
     def end_session(self, peer_id: str, session_id: str) -> None:
         with self._lock:

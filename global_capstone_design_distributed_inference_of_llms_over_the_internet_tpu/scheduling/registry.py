@@ -57,6 +57,10 @@ class ServerRecord:
     stage_index: Optional[int] = None      # fixed-split mode stage number
     cache_tokens_left: Optional[int] = None  # petals/server/server.py:721
     address: Optional[str] = None          # "host:port" for the TCP data plane
+    # Measured RTTs (seconds) to likely next-hop peers, published with each
+    # heartbeat — the _ping_next_servers signal (petals/server/server.py:760-767)
+    # consumed by scheduling.routing's latency-aware planner.
+    next_server_rtts: Optional[Dict[str, float]] = None
     timestamp: float = dataclasses.field(default_factory=time.monotonic)
     expires_at: float = 0.0
 
@@ -86,7 +90,8 @@ class PlacementRegistry:
             self._servers[record.peer_id] = record
 
     def heartbeat(self, peer_id: str, throughput: Optional[float] = None,
-                  cache_tokens_left: Optional[int] = None) -> bool:
+                  cache_tokens_left: Optional[int] = None,
+                  next_server_rtts: Optional[Dict[str, float]] = None) -> bool:
         """Refresh TTL (+ optionally throughput, mirroring
         ``update_server_throughput_on_dht``). Returns False if unknown."""
         now = time.monotonic()
@@ -100,6 +105,8 @@ class PlacementRegistry:
                 rec.throughput = throughput
             if cache_tokens_left is not None:
                 rec.cache_tokens_left = cache_tokens_left
+            if next_server_rtts is not None:
+                rec.next_server_rtts = dict(next_server_rtts)
             return True
 
     def unregister(self, peer_id: str) -> None:
