@@ -228,7 +228,8 @@ def run_fused(args, cfg: ModelConfig, params) -> int:
 
     if args.temperature > 0:
         logger.warning("fused mode samples greedily (temperature ignored)")
-    return _generate_and_report(args, generate, cfg)
+    return _generate_and_report(args, generate, cfg,
+                                supports_speculative=False)
 
 
 def run_oracle(args, cfg: ModelConfig, params) -> int:
@@ -283,10 +284,12 @@ def run_oracle(args, cfg: ModelConfig, params) -> int:
         return GenerationResult(tokens=tokens, ttft_s=ttft,
                                 decode_times_s=decode_times, stopped_by=stopped)
 
-    return _generate_and_report(args, generate, cfg)
+    return _generate_and_report(args, generate, cfg,
+                                supports_speculative=False)
 
 
-def _generate_and_report(args, generate_fn, cfg: ModelConfig) -> int:
+def _generate_and_report(args, generate_fn, cfg: ModelConfig,
+                         supports_speculative: bool = True) -> int:
     tokenizer = load_tokenizer(args.checkpoint)
     prompt_ids = tokenizer.encode(args.prompt)
     prompt_ids = [i % cfg.vocab_size for i in prompt_ids]
@@ -296,8 +299,15 @@ def _generate_and_report(args, generate_fn, cfg: ModelConfig) -> int:
     )
     eos = getattr(tokenizer, "eos_token_id", None)
 
+    kw = {}
+    if getattr(args, "speculative_k", 0):
+        if supports_speculative:
+            kw["speculative_k"] = args.speculative_k
+        else:
+            logger.warning("--speculative_k is ignored in --mode %s "
+                           "(pipeline-client modes only)", args.mode)
     res = generate_fn(prompt_ids, args.max_new_tokens, sampling=sampling,
-                      eos_token_id=eos)
+                      eos_token_id=eos, **kw)
     text = tokenizer.decode(res.tokens)
     # The reference's closing report (src/main.py:213-225): TTFT, decode
     # time, tokens/s.
@@ -558,6 +568,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--top_p", type=float, default=0.9)
     p.add_argument("--top_k", type=int, default=50)
     p.add_argument("--repetition_penalty", type=float, default=1.5)
+    p.add_argument("--speculative_k", type=int, default=0,
+                   help="speculative decoding: draft up to K tokens per "
+                        "round trip (n-gram prompt lookup), verified by the "
+                        "final stage; greedy only (--temperature 0)")
     p.add_argument("--request_timeout", type=float, default=60.0)
     # Host offload (reference --use_cpu_offload / --keep_layers_on_gpu,
     # src/main.py flag table): span weights in host RAM, streamed per layer.

@@ -117,8 +117,13 @@ class GenerationResult:
 
     @property
     def decode_tokens_per_s(self) -> float:
+        # TOKENS decoded over decode wall time, not len(decode_times_s): a
+        # speculative round contributes ONE timing entry but up to K+1
+        # tokens; counting entries would understate speculative throughput by
+        # the acceptance factor. tokens[0] came from the prefill (TTFT).
         total = sum(self.decode_times_s)
-        return (len(self.decode_times_s) / total) if total > 0 else 0.0
+        decoded = max(len(self.tokens) - 1, 0)
+        return (decoded / total) if total > 0 else 0.0
 
 
 class PipelineClient:
@@ -414,16 +419,21 @@ class PipelineClient:
               generated: Sequence[int] = (), step_seed: int = 0,
               stage_times: Dict[str, float],
               hypo_ids: Optional[Tuple[int, ...]] = None,
-              num_logprobs: int = 0) -> StageResponse:
+              num_logprobs: int = 0,
+              draft_tokens: Optional[Tuple[int, ...]] = None,
+              start_from_position: Optional[int] = None) -> StageResponse:
         """Send the activation through every remote hop; return the final
-        hop's response: a sampled token, or (num_logprobs > 0, beam mode)
-        per-row top-N candidates."""
+        hop's response: a sampled token, (num_logprobs > 0, beam mode)
+        per-row top-N candidates, or (draft_tokens set, speculative mode)
+        the verified token run."""
         sampling = sampling or SamplingParams()
         if self.use_push_chain:
             return self._walk_chain(
                 hidden, seq_len, cur_len, session_id, is_prefill=is_prefill,
                 max_length=max_length, sampling=sampling, generated=generated,
                 step_seed=step_seed, stage_times=stage_times,
+                draft_tokens=draft_tokens,
+                start_from_position=start_from_position,
             )
         cur = hidden
         for hop in self.route():
@@ -441,6 +451,8 @@ class PipelineClient:
                 end_block=hop.end_block,
                 hypo_ids=hypo_ids,
                 num_logprobs=num_logprobs,
+                draft_tokens=draft_tokens,
+                start_from_position=start_from_position,
             )
             t0 = time.monotonic()
             resp = self._call_with_recovery(hop, req)
@@ -460,6 +472,11 @@ class PipelineClient:
                     if not resp.is_beam:
                         raise RuntimeError(
                             f"final hop {hop.key} returned no beam candidates"
+                        )
+                elif draft_tokens is not None:
+                    if not resp.is_speculative:
+                        raise RuntimeError(
+                            f"final hop {hop.key} returned no verified tokens"
                         )
                 elif not resp.is_token:
                     raise RuntimeError(f"final hop {hop.key} returned no token")
@@ -483,7 +500,9 @@ class PipelineClient:
                        cur_len: int, session_id: str, *, is_prefill: bool,
                        is_replay: bool, max_length: int,
                        sampling: SamplingParams, generated: Sequence[int],
-                       step_seed: int) -> StageRequest:
+                       step_seed: int,
+                       draft_tokens: Optional[Tuple[int, ...]] = None,
+                       start_from_position: Optional[int] = None) -> StageRequest:
         nxt = []
         for h in hops[1:]:
             rec = self.registry.get(h.peer_id)
@@ -500,6 +519,8 @@ class PipelineClient:
             generated_tokens=clip_generated(generated), step_seed=step_seed,
             start_block=hops[0].start_block, end_block=hops[0].end_block,
             next_servers=tuple(nxt),
+            draft_tokens=draft_tokens,
+            start_from_position=start_from_position,
         )
 
     def _replay_chain(self, hops: List[Hop], session_id: str,
@@ -536,7 +557,9 @@ class PipelineClient:
                     *, is_prefill: bool, max_length: int,
                     sampling: SamplingParams, generated: Sequence[int],
                     step_seed: int,
-                    stage_times: Dict[str, float]) -> StageResponse:
+                    stage_times: Dict[str, float],
+                    draft_tokens: Optional[Tuple[int, ...]] = None,
+                    start_from_position: Optional[int] = None) -> StageResponse:
         touched = self._session_peers.setdefault(session_id, set())
         last_exc: Optional[Exception] = None
         blacklist_cleared = False
@@ -559,7 +582,8 @@ class PipelineClient:
                 hops, hidden, seq_len, cur_len, session_id,
                 is_prefill=is_prefill, is_replay=attempt > 0,
                 max_length=max_length, sampling=sampling, generated=generated,
-                step_seed=step_seed,
+                step_seed=step_seed, draft_tokens=draft_tokens,
+                start_from_position=start_from_position,
             )
             t0 = time.monotonic()
             try:
@@ -596,7 +620,10 @@ class PipelineClient:
                 self.CHAIN_KEY, session_id,
                 JournalEntry(np.asarray(hidden), seq_len, cur_len),
             )
-            if not resp.is_token:
+            if draft_tokens is not None:
+                if not resp.is_speculative:
+                    raise RuntimeError("push chain returned no verified tokens")
+            elif not resp.is_token:
                 raise RuntimeError("push chain returned no token "
                                    "(route must end at the final stage)")
             return resp
@@ -617,11 +644,26 @@ class PipelineClient:
         eos_token_id: Optional[int] = None,
         session_id: Optional[str] = None,
         max_length: Optional[int] = None,
+        speculative_k: int = 0,
+        draft_fn=None,
     ) -> GenerationResult:
+        """``speculative_k > 0`` enables speculative decoding: per decode
+        round the client drafts up to K tokens (``draft_fn(context, k)``,
+        default n-gram prompt lookup — runtime.speculative), ships them as
+        one multi-token step, and the final stage verifies greedily —
+        amortizing the per-token pipeline round trip the reference pays
+        (its dominant latency, SURVEY.md §3.2). Greedy-only (temperature 0):
+        acceptance compares against argmax, so the output is token-identical
+        to non-speculative greedy decoding."""
         sampling = sampling or SamplingParams()
+        if speculative_k > 0 and not sampling.greedy:
+            raise ValueError("speculative decoding requires greedy sampling "
+                             "(temperature <= 0)")
         session_id = session_id or f"sess-{time.monotonic_ns():x}"
         prompt_len = len(prompt_ids)
-        max_length = max_length or (prompt_len + max_new_tokens)
+        max_length = max_length or (
+            prompt_len + max_new_tokens
+            + (speculative_k if speculative_k > 0 else 0))
 
         ids = jnp.asarray(np.asarray(prompt_ids, np.int32)[None, :])
         generated: List[int] = []
@@ -644,9 +686,16 @@ class PipelineClient:
         generated.append(resp.token_id)
 
         # ---- decode loop (src/main.py:164-211) ----
+        # ONE loop serves both modes: a plain decode step is the degenerate
+        # speculative round with zero drafts (k=0 never drafts, never sends
+        # start_from_position — byte-identical requests to the pre-speculative
+        # protocol).
         decode_times: List[float] = []
         cur_len = prompt_len
-        for step in range(1, max_new_tokens):
+        if draft_fn is None and speculative_k > 0:
+            from .speculative import ngram_draft as draft_fn
+        context = [int(t) for t in prompt_ids] + generated
+        while len(generated) < max_new_tokens:
             if eos_token_id is not None and generated[-1] == eos_token_id:
                 stopped_by = "eos"
                 break
@@ -656,29 +705,78 @@ class PipelineClient:
                 stopped_by = "repeat"
                 break
             t0 = time.monotonic()
-            step_ids = jnp.asarray([[generated[-1]]], jnp.int32)
+            drafts = (tuple(draft_fn(context, speculative_k))
+                      if speculative_k > 0 else ())
+            # start_from_position rides every SPECULATIVE step (stage0's
+            # local cache too): it truncates the previous round's rejected
+            # overhang before this round appends.
+            spos = cur_len if speculative_k > 0 else None
+            step_ids = jnp.asarray([[generated[-1], *drafts]], jnp.int32)
+            t_in = 1 + len(drafts)
             s0_resp = self.stage0.forward(StageRequest(
-                session_id=session_id, hidden=step_ids, seq_len=1,
+                session_id=session_id, hidden=step_ids, seq_len=t_in,
                 cur_len=cur_len, is_prefill=False, max_length=max_length,
-                sampling=sampling,
+                sampling=sampling, start_from_position=spos,
             ))
-            times = {}
+            times: Dict[str, float] = {}
             resp = self._walk(
-                s0_resp.hidden, 1, cur_len, session_id,
+                s0_resp.hidden, t_in, cur_len, session_id,
                 is_prefill=False, max_length=max_length, sampling=sampling,
-                generated=generated, step_seed=self.seed + step,
+                generated=generated, step_seed=self.seed + len(generated),
                 stage_times=times,
+                draft_tokens=drafts if drafts else None,
+                start_from_position=spos,
             )
+            accepted = list(resp.tokens) if drafts else [resp.token_id]
+            if drafts:
+                # Shrink the round's journal entries to the accepted prefix:
+                # replay must rebuild only VALID KV positions.
+                self._amend_speculative_journal(session_id, len(accepted))
             decode_times.append(time.monotonic() - t0)
             self.decode_stage_history.append(times)
-            generated.append(resp.token_id)
-            cur_len += 1
+            cur_len += len(accepted)   # [g_last] + n_acc drafts consumed
+            # Stop conditions are checked PER TOKEN inside the accepted run:
+            # a round may overshoot the EOS / 5×-repeat point, and the output
+            # must match single-token decoding exactly.
+            stop = None
+            for tok in accepted:
+                if len(generated) >= max_new_tokens:
+                    break
+                generated.append(int(tok))
+                context.append(int(tok))
+                if eos_token_id is not None and tok == eos_token_id:
+                    stop = "eos"
+                    break
+                if len(generated) >= REPEAT_STOP and len(
+                    set(generated[-REPEAT_STOP:])
+                ) == 1:
+                    stop = "repeat"
+                    break
+            if stop is not None:
+                stopped_by = stop
+                break
 
         self._end_session(session_id)
         return GenerationResult(
             tokens=generated, ttft_s=ttft, decode_times_s=decode_times,
             stopped_by=stopped_by,
         )
+
+    def _amend_speculative_journal(self, session_id: str, keep: int) -> None:
+        """Truncate the just-journaled speculative entries to the accepted
+        prefix (`keep` = n_accepted + 1 positions: the last real token plus
+        the accepted drafts). Rejected positions must never be replayed into
+        a replacement peer — contiguity is preserved because the next round's
+        cur_len advances by exactly `keep`."""
+        keys = ([self.CHAIN_KEY] if self.use_push_chain
+                else [hop.key for hop in (self._route or [])])
+        for key in keys:
+            entries = self.journal.get(key, {}).get(session_id)
+            if entries:
+                e = entries[-1]
+                if e.seq_len > keep:
+                    entries[-1] = JournalEntry(
+                        e.hidden[:, :keep], keep, e.cur_len, e.hypo_ids)
 
     # ------------------------------------------------------------------
     # Beam search (client-side bookkeeping; servers reorder KV by hypo_ids —

@@ -328,6 +328,8 @@ class StageExecutor:
         self.requests_served += 1
 
         if sub_spec.is_last:
+            if req.draft_tokens is not None:
+                return self._verify_drafts(req, outs, handle)
             out = outs[-1]  # chunk outputs are trimmed; sample from its tail
             if req.num_logprobs > 0:
                 # Beam mode: per-row top-N candidates, raw log-softmax (beam
@@ -395,6 +397,51 @@ class StageExecutor:
         )
         handle.advance(n)
         return out[:, :n]
+
+    def _verify_drafts(self, req: StageRequest, outs, handle: KVHandle) -> StageResponse:
+        """Speculative verification on the final stage (greedy accept).
+
+        The request's T = 1 + K positions are [last_accepted, d_1..d_K];
+        logits[i] predict the token AFTER consuming position i, so draft
+        d_{i+1} is accepted while d_{i+1} == argmax(logits[i]). Returns the
+        accepted run plus one correction/bonus token (argmax at the first
+        mismatch — or after the last draft when all K were right), and
+        REWINDS this stage's own KV past the rejected tail so the session is
+        immediately consistent here; upstream stages drop their overhang via
+        the next request's ``start_from_position`` (rewind semantics of
+        petals handler.py:163-168, reused as speculative rollback).
+
+        Greedy-only by contract: acceptance compares against argmax, which is
+        exactly the temperature<=0 sampler (``src/rpc_handler.py:334-335``
+        applies greedy BEFORE penalties) — so output is token-identical to
+        non-speculative greedy decoding. The client enforces the contract.
+        """
+        drafts = np.asarray(req.draft_tokens, np.int64)
+        k = int(drafts.shape[0])
+        t_real = req.seq_len
+        if t_real != k + 1:
+            raise StageExecutionError(
+                f"speculative step carries {t_real} positions for {k} drafts "
+                "(want K+1)"
+            )
+        logits = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+        preds = np.asarray(jnp.argmax(logits[0], axis=-1))  # [T]
+        n_acc = 0
+        while n_acc < k and int(preds[n_acc]) == int(drafts[n_acc]):
+            n_acc += 1
+        tokens = tuple(int(t) for t in preds[: n_acc + 1])
+        # Rewind our own cache: positions for rejected drafts are garbage.
+        valid = req.cur_len + n_acc + 1
+        try:
+            handle.rewind(valid)
+        except ValueError as exc:  # pragma: no cover - defensive
+            raise StageExecutionError(str(exc)) from exc
+        return StageResponse(
+            session_id=req.session_id,
+            tokens=tokens,
+            n_accepted=n_acc,
+            cache_len=handle.cache_len,
+        )
 
     def _sample(self, logits: jnp.ndarray, t_real: int, req: StageRequest) -> int:
         """Final-stage sampling from the last REAL token's logits, using the

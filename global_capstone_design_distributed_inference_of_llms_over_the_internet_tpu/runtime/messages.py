@@ -67,6 +67,15 @@ class StageRequest:
     # of a sampled token — the client runs the beam bookkeeping.
     hypo_ids: Optional[Tuple[int, ...]] = None
     num_logprobs: int = 0
+    # Speculative decoding (no reference counterpart — a TPU-build extension
+    # that attacks the reference's dominant cost, one WAN round trip per
+    # token): ``hidden`` carries 1 + K positions — the last accepted token
+    # followed by K client-drafted tokens — and ``draft_tokens`` holds those
+    # K draft ids. Intermediate stages treat it as a normal multi-token step;
+    # the FINAL stage greedily verifies (accept while draft[i] ==
+    # argmax(logits[i])), rewinds its own KV past the rejected tail, and
+    # returns the accepted tokens plus one correction/bonus token.
+    draft_tokens: Optional[Tuple[int, ...]] = None
     # Push-chain route (the ``next_servers`` metadata of Petals'
     # server→server push, ``petals/server/handler.py:320-350``): the hops
     # AFTER this one. A server that produced hidden output forwards it
@@ -111,10 +120,20 @@ class StageResponse:
     # continuation candidates from the final stage's logits.
     top_tokens: Optional[Tuple[Tuple[int, ...], ...]] = None     # [B][N]
     top_logprobs: Optional[Tuple[Tuple[float, ...], ...]] = None  # [B][N]
+    # Speculative mode (request.draft_tokens set): the verified output —
+    # n_accepted accepted drafts followed by one correction/bonus token
+    # (len == n_accepted + 1). cache_len reflects the final stage's KV AFTER
+    # rewinding past the rejected tail.
+    tokens: Optional[Tuple[int, ...]] = None
+    n_accepted: Optional[int] = None
 
     @property
     def is_token(self) -> bool:
         return self.token_id is not None
+
+    @property
+    def is_speculative(self) -> bool:
+        return self.tokens is not None
 
     @property
     def is_beam(self) -> bool:

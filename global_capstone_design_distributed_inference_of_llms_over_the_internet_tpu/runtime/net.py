@@ -172,6 +172,8 @@ def _request_header(req: StageRequest, tensor_meta: dict) -> dict:
         "hypo_ids": None if req.hypo_ids is None else list(req.hypo_ids),
         "num_logprobs": req.num_logprobs,
         "start_from_position": req.start_from_position,
+        "draft_tokens": (None if req.draft_tokens is None
+                         else list(req.draft_tokens)),
         "tensor": tensor_meta,
     }
 
@@ -199,6 +201,8 @@ def _header_to_request(h: dict, payload: bytes) -> StageRequest:
                   else tuple(h["hypo_ids"])),
         num_logprobs=h.get("num_logprobs", 0),
         start_from_position=h.get("start_from_position"),
+        draft_tokens=(None if h.get("draft_tokens") is None
+                      else tuple(h["draft_tokens"])),
     )
 
 
@@ -465,6 +469,13 @@ class TcpStageServer(_FramedTcpServer):
                     "verb": "token", "session_id": resp.session_id,
                     "token_id": resp.token_id, "cache_len": resp.cache_len,
                 })
+            elif resp.is_speculative:
+                _send_frame(sock, {
+                    "verb": "spec", "session_id": resp.session_id,
+                    "tokens": list(resp.tokens),
+                    "n_accepted": resp.n_accepted,
+                    "cache_len": resp.cache_len,
+                })
             elif resp.is_beam:
                 _send_frame(sock, {
                     "verb": "beam", "session_id": resp.session_id,
@@ -717,6 +728,13 @@ class TcpTransport(Transport):
             self._drop(peer_id)
             raise PeerUnavailable(f"peer {peer_id} connection failed: {exc}")
         verb = header.get("verb")
+        if verb == "spec":
+            return StageResponse(
+                session_id=header["session_id"],
+                tokens=tuple(header["tokens"]),
+                n_accepted=header["n_accepted"],
+                cache_len=header["cache_len"],
+            )
         if verb == "token":
             return StageResponse(
                 session_id=header["session_id"],
