@@ -545,7 +545,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--mode",
                    choices=["local", "fused", "oracle",
-                            "registry", "serve", "client"],
+                            "registry", "serve", "client", "dcn-check"],
                    default="local")
     p.add_argument("--model", default="gpt2",
                    help="architecture preset (gpt2[-xl], llama-3-8b, ...)")
@@ -612,12 +612,48 @@ def build_parser() -> argparse.ArgumentParser:
                         "servers learn it from heartbeat responses")
     p.add_argument("--wire_dtype", choices=["bf16", "f32"], default="bf16",
                    help="activation compression on the wire")
+    # Multi-host DCN cluster (runtime.dcn; SURVEY.md §7.1 layer 7)
+    p.add_argument("--dcn_coordinator", default="127.0.0.1:31400",
+                   help="dcn-check: process 0's coordinator host:port")
+    p.add_argument("--num_processes", type=int, default=1,
+                   help="dcn-check: cluster size")
+    p.add_argument("--process_id", type=int, default=0,
+                   help="dcn-check: this process's rank")
+    p.add_argument("--dcn_cpu_devices", type=int, default=None,
+                   help="dcn-check: force N virtual CPU devices per process "
+                        "(testing without TPU hosts)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run to DIR "
                         "(view with TensorBoard / Perfetto)")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
+
+
+def run_dcn_check(args) -> int:
+    """Bring up this process's slot in a multi-host cluster and run the
+    cross-host collective smoke tests (runtime.dcn). Run once per host at
+    deployment time — the DCN analogue of the reference's reachability
+    validation (petals/server/reachability.py)."""
+    from .runtime import dcn
+
+    dcn.initialize(dcn.DcnConfig(
+        coordinator_address=args.dcn_coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+        cpu_devices_per_process=args.dcn_cpu_devices,
+    ))
+    import jax as _jax
+
+    got, want = dcn.sanity_check()
+    ring_ok = dcn.ring_shift()
+    ok = (got == want) and ring_ok
+    print(f"DCN_CHECK process={_jax.process_index()}/{_jax.process_count()} "
+          f"devices={_jax.local_device_count()}/{_jax.device_count()} "
+          f"psum={got}/{want} ring={'ok' if ring_ok else 'FAIL'} "
+          f"{'OK' if ok else 'FAIL'}", flush=True)
+    dcn.shutdown()
+    return 0 if ok else 1
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -628,6 +664,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     if args.mode == "registry":
         return run_registry(args, None, None)  # no model needed
+    if args.mode == "dcn-check":
+        return run_dcn_check(args)  # no model needed
     cfg, params = load_model(args)
     run = {"local": run_local, "fused": run_fused, "oracle": run_oracle,
            "serve": run_serve, "client": run_client}[args.mode]

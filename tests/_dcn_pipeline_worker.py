@@ -1,0 +1,62 @@
+"""Worker for tests/test_dcn.py::test_fused_pipeline_spans_processes.
+
+Joins a 2-process CPU cluster and runs the fused ICI pipeline over a
+("stage", "tp") mesh spanning BOTH processes — stages 0-1 on process 0,
+stages 2-3 on process 1, with the inter-stage ppermute crossing the process
+boundary (the DCN hop). Prints the stage-0 logits checksum so the parent
+can assert both processes computed identically.
+"""
+
+import os
+import sys
+
+# Script invocation puts tests/ (not the repo root) on sys.path.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime import (  # noqa: E402
+    dcn,
+)
+
+
+def main() -> int:
+    coordinator, pid = sys.argv[1], int(sys.argv[2])
+    dcn.initialize(dcn.DcnConfig(coordinator, 2, pid,
+                                 cpu_devices_per_process=2))
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+        init_params,
+        llama_config,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.parallel.pipeline import (
+        IciPipeline,
+    )
+
+    cfg = llama_config(vocab_size=128, hidden_size=32, num_layers=4,
+                       num_heads=4, num_kv_heads=2, intermediate_size=64,
+                       max_position_embeddings=32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = dcn.multihost_pipeline_mesh(num_stages=4, tp=1)
+    pipe = IciPipeline.build(cfg, params, num_stages=4, num_micro=2,
+                             mesh=mesh, tp=1)
+    k, v = pipe.init_kv(micro_batch=1, max_len=16)
+    ids = jnp.asarray(np.arange(8, dtype=np.int32).reshape(2, 1, 4) % 128)
+    logits, k, v = pipe.forward(ids, k, v, jnp.int32(0))
+    # One decode step too: the (T=1) serving hot path over the same mesh.
+    step = jnp.argmax(logits[:, :, -1:], axis=-1).astype(jnp.int32)
+    logits2, k, v = pipe.forward(step, k, v, jnp.int32(4))
+    jax.block_until_ready(logits2)
+    # process-spanning checksum: psum over the whole logits tensor is
+    # identical on every process iff the cluster agrees on the result.
+    checksum = float(jax.jit(
+        lambda x: jnp.sum(jnp.abs(x).astype(jnp.float32)))(logits2))
+    print(f"DCN_PIPE proc={pid} shape={tuple(logits2.shape)} "
+          f"checksum={checksum:.4f}", flush=True)
+    dcn.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
