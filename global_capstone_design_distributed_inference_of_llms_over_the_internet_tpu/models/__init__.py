@@ -6,6 +6,7 @@ from .config import (
     llama_config,
     mistral_config,
     mixtral_config,
+    qwen2_config,
 )
 from .transformer import (
     embed_tokens,
@@ -20,7 +21,8 @@ from .hf_import import config_from_hf, convert_state_dict, import_hf_model
 
 __all__ = [
     "ModelConfig", "PRESETS", "get_config", "gpt2_config", "llama_config",
-    "mistral_config", "mixtral_config", "embed_tokens", "full_forward",
+    "mistral_config", "mixtral_config", "qwen2_config", "embed_tokens",
+    "full_forward",
     "init_kv_cache", "init_params", "layer_forward", "lm_head", "stack_forward",
     "config_from_hf", "convert_state_dict", "import_hf_model",
 ]

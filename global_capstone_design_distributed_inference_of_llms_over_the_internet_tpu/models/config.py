@@ -16,7 +16,7 @@ from typing import Optional
 class ModelConfig:
     """Architecture hyperparameters for one decoder-only transformer family."""
 
-    model_type: str  # "gpt2" | "llama" | "mistral" | "mixtral"
+    model_type: str  # "gpt2" | "llama" | "mistral" | "mixtral" | "qwen2"
     vocab_size: int
     hidden_size: int
     num_layers: int
@@ -31,6 +31,7 @@ class ModelConfig:
     activation: str = "silu"       # "gelu" (gpt2) | "silu"
     mlp: str = "swiglu"            # "gelu_mlp" (gpt2: fc->act->proj) | "swiglu"
     use_bias: bool = False         # gpt2 uses biases everywhere; llama none
+    attn_qkv_bias: bool = False    # qwen2: biases on q/k/v ONLY (not o, not mlp)
     tie_word_embeddings: bool = True
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
@@ -118,6 +119,14 @@ def mistral_config(sliding_window: Optional[int] = 4096, **kw) -> ModelConfig:
     return dataclasses.replace(cfg, model_type="mistral", sliding_window=sliding_window)
 
 
+def qwen2_config(norm_eps: float = 1e-6, **kw) -> ModelConfig:
+    """Qwen2/Qwen2.5: LLaMA architecture + biases on the q/k/v projections
+    (and rms eps 1e-6). Extends the reference's model-family guard
+    (``src/llama_partition.py:82-83`` accepts llama/mistral/mixtral only)."""
+    cfg = llama_config(norm_eps=norm_eps, **kw)
+    return dataclasses.replace(cfg, model_type="qwen2", attn_qkv_bias=True)
+
+
 def mixtral_config(num_experts: int = 8, num_experts_per_tok: int = 2, **kw) -> ModelConfig:
     cfg = llama_config(**kw)
     return dataclasses.replace(
@@ -148,6 +157,16 @@ PRESETS = {
     "mixtral-8x7b": lambda: mixtral_config(
         vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
         num_kv_heads=8, intermediate_size=14336,
+    ),
+    "qwen2-0.5b": lambda: qwen2_config(
+        vocab_size=151936, hidden_size=896, num_layers=24, num_heads=14,
+        num_kv_heads=2, intermediate_size=4864, max_position_embeddings=32768,
+        rope_theta=1000000.0, tie_word_embeddings=True,
+    ),
+    "qwen2-7b": lambda: qwen2_config(
+        vocab_size=152064, hidden_size=3584, num_layers=28, num_heads=28,
+        num_kv_heads=4, intermediate_size=18944, max_position_embeddings=32768,
+        rope_theta=1000000.0,
     ),
 }
 

@@ -26,7 +26,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .config import ModelConfig, gpt2_config, llama_config, mistral_config, mixtral_config
+from .config import (
+    ModelConfig,
+    gpt2_config,
+    llama_config,
+    mistral_config,
+    mixtral_config,
+    qwen2_config,
+)
 
 Params = Dict[str, Any]
 
@@ -73,6 +80,30 @@ def config_from_hf(hf_cfg) -> ModelConfig:
     )
     if mt == "llama":
         return llama_config(**common)
+    if mt == "qwen2":
+        common["norm_eps"] = getattr(hf_cfg, "rms_norm_eps", 1e-6)
+        cfg = qwen2_config(**common)
+        # Qwen2 configs carry sliding_window but only apply it when
+        # use_sliding_window is set (HF Qwen2Config semantics). HF further
+        # runs FULL attention for layers < max_window_layers and windowed
+        # attention only above; our window is global, so only the uniform
+        # cases map — a mixed checkpoint must fail LOUDLY, not silently
+        # diverge past the window.
+        if getattr(hf_cfg, "use_sliding_window", False):
+            import dataclasses
+
+            mwl = getattr(hf_cfg, "max_window_layers",
+                          hf_cfg.num_hidden_layers)
+            if mwl <= 0:  # every layer windowed
+                cfg = dataclasses.replace(
+                    cfg, sliding_window=getattr(hf_cfg, "sliding_window", None))
+            elif mwl < hf_cfg.num_hidden_layers:  # mixed full/windowed
+                raise ValueError(
+                    "qwen2 checkpoint uses per-layer sliding windows "
+                    f"(max_window_layers={mwl} of "
+                    f"{hf_cfg.num_hidden_layers} layers) — unsupported")
+            # mwl >= num layers: no layer is windowed; keep full attention.
+        return cfg
     if mt == "mistral":
         return mistral_config(
             sliding_window=getattr(hf_cfg, "sliding_window", None), **common
@@ -90,7 +121,9 @@ def config_from_hf(hf_cfg) -> ModelConfig:
             cfg = dataclasses.replace(cfg, sliding_window=sw)
         return cfg
     # Mirrors the reference's model_type guard (src/llama_partition.py:82-83).
-    raise ValueError(f"unsupported model_type: {mt} (expected gpt2/llama/mistral/mixtral)")
+    raise ValueError(
+        f"unsupported model_type: {mt} "
+        "(expected gpt2/llama/mistral/mixtral/qwen2)")
 
 
 def _gpt2_layer(sd: Mapping[str, Any], i: int) -> Params:
@@ -129,6 +162,10 @@ def _llama_layer(sd: Mapping[str, Any], i: int, cfg: ModelConfig) -> Params:
             "wo": _np(sd[pre + "self_attn.o_proj.weight"]).T,
         },
     }
+    if cfg.attn_qkv_bias:  # qwen2: q/k/v biases, no o bias
+        p["attn"]["bq"] = _np(sd[pre + "self_attn.q_proj.bias"])
+        p["attn"]["bk"] = _np(sd[pre + "self_attn.k_proj.bias"])
+        p["attn"]["bv"] = _np(sd[pre + "self_attn.v_proj.bias"])
     if cfg.is_moe:
         gate = _np(sd[pre + "block_sparse_moe.gate.weight"]).T  # [D, E]
         wg = np.stack([

@@ -144,8 +144,10 @@ def params_per_block(cfg: ModelConfig) -> int:
     d, i = cfg.hidden_size, cfg.intermediate_size
     h, hkv, dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     attn = d * h * dh + 2 * d * hkv * dh + h * dh * d
+    if cfg.use_bias or cfg.attn_qkv_bias:
+        attn += h * dh + 2 * hkv * dh   # q/k/v biases (gpt2 AND qwen2)
     if cfg.use_bias:
-        attn += h * dh + 2 * hkv * dh + d
+        attn += d                        # o bias (gpt2 only)
     if cfg.is_moe:
         mlp = cfg.num_experts * 3 * d * i + d * cfg.num_experts
     elif cfg.mlp == "swiglu":

@@ -62,10 +62,11 @@ def init_layer_params(rng: jax.Array, cfg: ModelConfig, dtype=jnp.float32) -> Pa
     else:
         p["ln1"] = {"w": jnp.ones((d,), dtype)}
         p["ln2"] = {"w": jnp.ones((d,), dtype)}
-    if cfg.use_bias:
+    if cfg.use_bias or cfg.attn_qkv_bias:
         p["attn"]["bq"] = jnp.zeros((h * dh,), dtype)
         p["attn"]["bk"] = jnp.zeros((hkv * dh,), dtype)
         p["attn"]["bv"] = jnp.zeros((hkv * dh,), dtype)
+    if cfg.use_bias:
         p["attn"]["bo"] = jnp.zeros((d,), dtype)
     if cfg.is_moe:
         e = cfg.num_experts

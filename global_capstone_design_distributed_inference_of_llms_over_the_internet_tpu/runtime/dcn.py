@@ -151,11 +151,16 @@ def ring_shift() -> bool:
 
     mesh = global_mesh(("dp",))
     n = jax.device_count()
-    n_local = jax.local_device_count()
-    first = jax.process_index() * n_local
-    local = np.asarray([[float(first + i)] for i in range(n_local)], np.float32)
-    arr = jax.make_array_from_process_local_data(
-        NamedSharding(mesh, P("dp")), local)
+    # Each shard's value = its GLOBAL row index, derived from the shard's own
+    # index (not process_index * local_count, which assumes every process
+    # contributes the same device count — false on heterogeneous clusters).
+    arr = jax.make_array_from_callback(
+        (n, 1), NamedSharding(mesh, P("dp")),
+        lambda idx: np.asarray(
+            [[float(i)] for i in range(idx[0].start or 0,
+                                       idx[0].stop if idx[0].stop is not None
+                                       else n)],
+            np.float32))
 
     @jax.jit
     def f(x):

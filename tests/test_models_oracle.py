@@ -65,11 +65,25 @@ def tiny_mixtral():
     return MixtralForCausalLM(hf_cfg).eval()
 
 
+def tiny_qwen2():
+    torch.manual_seed(0)
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    hf_cfg = Qwen2Config(
+        vocab_size=320, hidden_size=64, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=128,
+        max_position_embeddings=128, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    return Qwen2ForCausalLM(hf_cfg).eval()
+
+
 FACTORIES = {
     "gpt2": tiny_gpt2,
     "llama": tiny_llama,
     "mistral": tiny_mistral,
     "mixtral": tiny_mixtral,
+    "qwen2": tiny_qwen2,
 }
 
 
@@ -98,7 +112,7 @@ def test_prefill_logits_match_hf(family):
     assert (np.asarray(logits).argmax(-1) == ref_logits.argmax(-1)).all()
 
 
-@pytest.mark.parametrize("family", ["gpt2", "llama"])
+@pytest.mark.parametrize("family", ["gpt2", "llama", "qwen2"])
 def test_incremental_decode_matches_full_recompute(family):
     """Prefill + per-token decode through the KV cache must equal one full
     forward over the whole sequence (the cache is exact, not approximate)."""
@@ -132,7 +146,7 @@ def test_incremental_decode_matches_full_recompute(family):
         )
 
 
-@pytest.mark.parametrize("family", ["gpt2", "llama", "mistral"])
+@pytest.mark.parametrize("family", ["gpt2", "llama", "mistral", "qwen2"])
 def test_greedy_generation_token_identical(family):
     """End-to-end greedy decode vs transformers .generate — token identical."""
     hf_model = FACTORIES[family]()

@@ -49,6 +49,14 @@ def tiny_cfg(family="llama"):
     if family == "gpt2":
         return gpt2_config(vocab_size=257, hidden_size=64, num_layers=8,
                            num_heads=4, max_position_embeddings=256)
+    if family == "qwen2":
+        from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+            qwen2_config,
+        )
+
+        return qwen2_config(vocab_size=257, hidden_size=64, num_layers=8,
+                            num_heads=4, num_kv_heads=2, intermediate_size=128,
+                            max_position_embeddings=256)
     return llama_config(vocab_size=257, hidden_size=64, num_layers=8,
                         num_heads=4, num_kv_heads=2, intermediate_size=128,
                         max_position_embeddings=256)
@@ -120,6 +128,16 @@ def test_pipeline_greedy_matches_oracle():
     assert res.tokens == ref
     assert res.ttft_s > 0
     assert set(client.last_prefill_stage_times) == {"stage1", "stage2", "stage3"}
+
+
+def test_pipeline_qwen2_matches_oracle():
+    # Qwen2 (llama + q/k/v biases) through the full distributed pipeline.
+    cfg = tiny_cfg("qwen2")
+    client, _, _, params, _ = build_cluster(cfg, splits="3,6")
+    sampling = SamplingParams(temperature=0.0)
+    prompt = [5, 9, 23, 7, 81]
+    res = client.generate(prompt, max_new_tokens=8, sampling=sampling)
+    assert res.tokens == oracle_generate(cfg, params, prompt, 8, sampling)
 
 
 def test_pipeline_sampled_matches_oracle():
