@@ -46,8 +46,10 @@ PREFILL = 64
 DECODE_STEPS = 64
 # Cache bucket: smallest power-of-two holding prefill + decode — matches
 # the runtime's bucket policy (runtime/kv_cache.py DEFAULT_BUCKETS), so the
-# bench exercises the same shapes serving does.
-MAX_LEN = 256
+# bench exercises the same shapes serving does. (128 holds 64+64 exactly;
+# the previous 256 doubled per-step attention-cache traffic for nothing —
+# measured 3002 -> 3397 tok/s on the v5e chip.)
+MAX_LEN = 128
 assert PREFILL + DECODE_STEPS <= MAX_LEN
 
 
