@@ -545,7 +545,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--mode",
                    choices=["local", "fused", "oracle",
-                            "registry", "serve", "client", "dcn-check"],
+                            "registry", "serve", "client", "status",
+                            "dcn-check"],
                    default="local")
     p.add_argument("--model", default="gpt2",
                    help="architecture preset (gpt2[-xl], llama-3-8b, ...)")
@@ -630,6 +631,75 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def run_status(args) -> int:
+    """Swarm inspector: live records, per-block coverage summary (the
+    reference's ``get_remote_module_infos`` coverage log,
+    ``src/dht_utils.py:227-240``), and a per-server `info` probe."""
+    from .runtime.net import RemoteRegistry, TcpTransport
+    from .scheduling.registry import PlacementRegistry as _PR
+
+    registry = RemoteRegistry(args.registry_addr)
+    # ONE registry snapshot: records, coverage, and info-probe addressing all
+    # derive from it, so the report describes a single swarm state (and the
+    # registry sees one list RPC, not N+2).
+    records = registry.live_servers()
+    if not records:
+        print("no live servers")
+        return 1
+    total = args.total_blocks or max(r.end_block for r in records)
+    if not args.total_blocks:
+        print("warning: total_blocks inferred from LIVE records — dead "
+              "tail-stage servers shrink it; pass --total_blocks for a "
+              "reliable health check")
+    print(f"{len(records)} live server(s); total_blocks={total}")
+    snap = _PR()
+    for r in records:
+        snap.register(r)
+    tx = TcpTransport(snap, wire_dtype=args.wire_dtype)
+    for r in sorted(records, key=lambda r: (r.start_block, r.peer_id)):
+        extra = ""
+        if r.address:
+            try:
+                inf = tx.info(r.peer_id, timeout=3.0)
+                extra = (f" served={inf.get('requests_served')}"
+                         f" rtt_probe_ok")
+            except Exception as exc:
+                extra = f" info_probe_failed({type(exc).__name__})"
+        rtts = ("" if not r.next_server_rtts else
+                " rtts=" + ",".join(f"{p}:{v * 1e3:.1f}ms"
+                                    for p, v in r.next_server_rtts.items()))
+        print(f"  {r.peer_id:24s} [{r.start_block:3d},{r.end_block:3d}) "
+              f"{r.state:8s} thr={r.throughput:8.2f} "
+              f"cache_left={r.cache_tokens_left}"
+              f"{' FINAL' if r.final_stage else ''}{rtts}{extra}")
+    # Coverage summary: contiguous runs of equal server-count, the exact
+    # shape of the reference's log (src/dht_utils.py:227-240). The
+    # CLIENT-LOCAL prefix (stage 0's span, never served remotely — the
+    # lb_min_block floor, src/main.py:338-339) is taken from --splits when
+    # given; it is NOT inferred from live records, because "lowest live
+    # span" would silently relabel a dead low-block server as client-local.
+    base = parse_splits(args.splits)[0] if args.splits else 0
+    cov = [sum(1 for r in records if r.start_block <= b < r.end_block)
+           for b in range(total)]
+    runs, start = [], base
+    for b in range(base + 1, total + 1):
+        if b == total or cov[b] != cov[start]:
+            runs.append((start, b, cov[start]))
+            start = b
+    prefix = f"[0,{base}) client-local; " if base else ""
+    print("coverage: " + prefix + ", ".join(
+        f"[{a},{b})x{n}" + ("  <-- UNCOVERED" if n == 0 else "")
+        for a, b, n in runs))
+    tx.close()
+    healthy = all(n > 0 for _, _, n in runs)
+    if not any(r.final_stage for r in records):
+        # Catches the dead-tail case even when total_blocks was inferred:
+        # a swarm with no live final stage cannot finish any request.
+        print("no live FINAL-stage server  <-- UNHEALTHY")
+        healthy = False
+    return 0 if healthy else 2
+
+
 def run_dcn_check(args) -> int:
     """Bring up this process's slot in a multi-host cluster and run the
     cross-host collective smoke tests (runtime.dcn). Run once per host at
@@ -666,6 +736,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_registry(args, None, None)  # no model needed
     if args.mode == "dcn-check":
         return run_dcn_check(args)  # no model needed
+    if args.mode == "status":
+        return run_status(args)  # no model needed
     cfg, params = load_model(args)
     run = {"local": run_local, "fused": run_fused, "oracle": run_oracle,
            "serve": run_serve, "client": run_client}[args.mode]

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Kill a running swarm stage process — the reference's fault-injection tool
+(``scripts/kill_stage.py:16-67``: grep ``ps aux`` for ``--stage N`` and
+SIGTERM it) for the TCP swarm's process layout.
+
+Targets processes running ``--mode serve`` (optionally filtered by
+``--stage N`` or ``--peer_id``), the registry (``--registry``), or an
+elastic server by pid order (``--nth``). Use while a client generates to
+watch the failover path (docs/FAULT_TOLERANCE.md): the client must mark the
+peer failed, re-discover, replay its journal, and keep producing tokens.
+
+    python scripts/kill_stage.py --stage 2          # SIGTERM stage-2 server
+    python scripts/kill_stage.py --nth 0 --signal 9 # SIGKILL first server
+    python scripts/kill_stage.py --list             # show candidates only
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def _flag_value(tokens, flag):
+    """Value of --flag in an argv token list; handles '--flag v' and
+    '--flag=v'. None when absent."""
+    for i, t in enumerate(tokens):
+        if t == flag:
+            return tokens[i + 1] if i + 1 < len(tokens) else None
+        if t.startswith(flag + "="):
+            return t.split("=", 1)[1]
+    return None
+
+
+def find_processes(stage=None, peer_id=None, registry=False):
+    out = subprocess.run(["ps", "-eo", "pid,args"], capture_output=True,
+                         text=True).stdout
+    hits = []
+    for line in out.splitlines()[1:]:
+        line = line.strip()
+        if not line:
+            continue
+        pid_s, _, args = line.partition(" ")
+        if "main" not in args or str(os.getpid()) == pid_s:
+            continue
+        # Token-exact matching: substring tests would make --stage 1 match
+        # '--stage 12' and --peer_id lb1 match 'lb10'.
+        tokens = args.split()
+        if _flag_value(tokens, "--mode") != ("registry" if registry
+                                             else "serve"):
+            continue
+        if stage is not None and _flag_value(tokens, "--stage") != str(stage):
+            continue
+        if peer_id is not None and _flag_value(tokens, "--peer_id") != peer_id:
+            continue
+        hits.append((int(pid_s), args))
+    return hits
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--stage", type=int, default=None,
+                   help="fixed-split server stage number to kill")
+    p.add_argument("--peer_id", default=None,
+                   help="kill the server advertising this peer id")
+    p.add_argument("--registry", action="store_true",
+                   help="kill the registry process instead of a server")
+    p.add_argument("--nth", type=int, default=None,
+                   help="kill the nth matching process (pid order)")
+    p.add_argument("--signal", type=int, default=signal.SIGTERM,
+                   help="signal number (default SIGTERM; 9 = SIGKILL models "
+                        "a hard crash — no TCP FIN until the OS cleans up)")
+    p.add_argument("--list", action="store_true",
+                   help="only print matching processes")
+    args = p.parse_args()
+
+    hits = sorted(find_processes(args.stage, args.peer_id, args.registry))
+    if not hits:
+        print("no matching swarm processes", file=sys.stderr)
+        return 1
+    if args.nth is not None:
+        if args.nth >= len(hits):
+            print(f"only {len(hits)} matches", file=sys.stderr)
+            return 1
+        hits = [hits[args.nth]]
+    for pid, cmd in hits:
+        print(f"{'would kill' if args.list else 'killing'} {pid}: {cmd[:120]}")
+        if not args.list:
+            os.kill(pid, args.signal)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

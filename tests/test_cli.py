@@ -47,3 +47,50 @@ def test_cli_modes_run(mode_args, capsys):
     assert rc == 0 or rc is None
     out = capsys.readouterr().out
     assert "TTFT" in out and "tokens/s" in out
+
+
+def test_status_mode_coverage_summary(capsys):
+    """--mode status prints live records + the per-block coverage summary
+    (the reference's get_remote_module_infos log, src/dht_utils.py:227-240)
+    and exits 2 when blocks are uncovered."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.net import (
+        RegistryServer,
+        RemoteRegistry,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.scheduling.registry import (
+        ServerRecord,
+    )
+
+    srv = RegistryServer(port=0)
+    srv.start()
+    try:
+        remote = RemoteRegistry(srv.address)
+        remote.register(ServerRecord(
+            peer_id="a", start_block=0, end_block=4, throughput=2.0,
+            next_server_rtts={"b": 0.012}))
+        remote.register(ServerRecord(
+            peer_id="b", start_block=4, end_block=8, final_stage=True))
+        rc = main(["--mode", "status", "--registry_addr", srv.address,
+                   "--total_blocks", "8"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "2 live server(s)" in out
+        assert "[0,8)x1" in out  # both spans serve 1 replica -> one run
+        assert "b:12.0ms" in out
+        # Now an uncovered hole -> exit 2 and an UNCOVERED marker.
+        remote.unregister("b")
+        rc = main(["--mode", "status", "--registry_addr", srv.address,
+                   "--total_blocks", "8"])
+        out = capsys.readouterr().out
+        assert rc == 2
+        assert "UNCOVERED" in out
+        # Without --total_blocks the range shrinks to the live records —
+        # but a swarm with no live FINAL stage must still read unhealthy
+        # (the dead-tail case the inferred total would otherwise mask).
+        rc = main(["--mode", "status", "--registry_addr", srv.address])
+        out = capsys.readouterr().out
+        assert rc == 2
+        assert "no live FINAL-stage server" in out
+        assert "inferred" in out  # the reliability warning
+    finally:
+        srv.stop()
