@@ -1,0 +1,341 @@
+"""Sequence-parallel stage serving: long-context prefill + decode with the
+KV cache SHARDED along the sequence axis of an intra-stage mesh.
+
+SURVEY.md §5.7: the reference's only long-context mechanism is single-server
+chunked prefill (bounding one GPU's peak activation memory —
+``petals/server/backend.py:129-143``); its KV cache still must fit one
+machine. This module is the TPU-native capability the survey marks as the
+place to EXCEED the reference: P devices hold P× the context at the same
+per-device HBM.
+
+Two phases, one engine (`SpStageRunner`):
+
+  * **prefill** — the prompt is sharded along T over the "sp" axis; every
+    layer runs ring attention (parallel.ring_attention: KV chunks rotate via
+    ppermute while each device accumulates its queries' online softmax).
+    The resulting per-layer K/V stay SHARDED — the prefix cache is a global
+    array with its sequence axis split across the mesh, never gathered.
+  * **decode** — the new token's hidden state is replicated; each device
+    attends over ITS prefix shard and the partial softmaxes combine with a
+    pmax/psum log-sum-exp reduction. Freshly generated tokens append to a
+    small REPLICATED tail cache (bounded by ``tail_max``), so decode writes
+    never cross devices: long context lives in the sharded prefix, the
+    generation tail is cheap everywhere.
+
+Numerics are exact (online softmax, fp32 accumulation), so outputs are
+asserted token-identical to the single-device oracle in
+tests/test_sp_stage.py. Sliding-window configs are rejected (ring masking
+is causal-only today).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..models.partition import StageSpec
+from ..models.transformer import _mlp, _norm, embed_tokens, make_rope
+from ..ops.rotary import apply_rope
+from .ring_attention import ring_attention
+
+Params = Dict[str, Any]
+
+NEG_INF = -1e30
+
+
+def _qkv(cfg: ModelConfig, p: Params, x: jnp.ndarray):
+    """Projections (+ optional biases), reshaped to heads. x: [B, T, D]."""
+    b, t, _ = x.shape
+    dh = cfg.head_dim
+    q = x @ p["wq"]
+    k = x @ p["wk"]
+    v = x @ p["wv"]
+    if "bq" in p:
+        q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+    return (q.reshape(b, t, -1, dh), k.reshape(b, t, -1, dh),
+            v.reshape(b, t, -1, dh))
+
+
+def _partial_scores(q, k, scale):
+    # q: [B, 1, Hkv, G, Dh]; k: [B, S, Hkv, Dh] -> [B, Hkv, G, S] f32
+    return jnp.einsum("bthgd,bshd->bhgs", q * scale, k,
+                      preferred_element_type=jnp.float32)
+
+
+def _masked_partial(qg, k, v, mask, scale):
+    """Online-softmax partial over one KV block. Returns (m, l, o) with
+    o un-normalized f32 [B, Hkv, G, Dh]."""
+    scores = _partial_scores(qg, k, scale)                     # [B,Hkv,G,S]
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)                               # [B,Hkv,G]
+    safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    probs = jnp.exp(scores - safe_m[..., None])
+    probs = jnp.where(scores <= NEG_INF / 2, 0.0, probs)
+    l = probs.sum(axis=-1)
+    o = jnp.einsum("bhgs,bshd->bhgd", probs.astype(jnp.float32),
+                   v.astype(jnp.float32))
+    return m, l, o
+
+
+def _combine(a, b):
+    """Merge two online-softmax partials (m, l, o)."""
+    ma, la, oa = a
+    mb, lb, ob = b
+    m = jnp.maximum(ma, mb)
+    safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    ca = jnp.exp(ma - safe_m)
+    cb = jnp.exp(mb - safe_m)
+    ca = jnp.where(ma <= NEG_INF / 2, 0.0, ca)
+    cb = jnp.where(mb <= NEG_INF / 2, 0.0, cb)
+    return m, la * ca + lb * cb, oa * ca[..., None] + ob * cb[..., None]
+
+
+class SpStageRunner:
+    """One stage's span executed sequence-parallel over `mesh[axis_name]`.
+
+    The role contract matches StageExecutor's (stage0 consumes token ids,
+    later stages hidden states; the last stage owns norm + head), but the
+    session cache is mesh-wide: prefix sharded on T, tail replicated.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        spec: StageSpec,
+        params: Params,
+        mesh: Mesh,
+        axis_name: str = "sp",
+        *,
+        tail_max: int = 512,
+        dtype=jnp.float32,
+    ):
+        if cfg.sliding_window:
+            raise ValueError("sp serving is causal-only (no sliding window)")
+        self.cfg = cfg
+        self.spec = spec
+        self.mesh = mesh
+        self.axis = axis_name
+        self.p = int(mesh.shape[axis_name])
+        self.tail_max = tail_max
+        self.dtype = jnp.dtype(dtype)
+        # Replicate the span's params over the mesh once.
+        repl = NamedSharding(mesh, P())
+        self.params = jax.device_put(params, repl)
+
+        self.prefix_pad = 0     # padded prefill length (sharded axis size)
+        self.prefix_len = 0     # REAL prompt tokens in the prefix cache
+        self.tail_len = 0       # decode tokens in the tail cache
+        self.pk = self.pv = None  # [L, B, prefix_pad, Hkv, Dh] sharded on T
+        self.tk = self.tv = None  # [L, B, tail_max, Hkv, Dh] replicated
+        self._prefill_fn = None
+        self._decode_fn = None
+
+    # ------------------------------------------------------------------
+
+    @property
+    def cache_len(self) -> int:
+        return self.prefix_len + self.tail_len
+
+    def _shard_seq(self):
+        return NamedSharding(self.mesh, P(None, None, self.axis))
+
+    # ------------------------------------------------------------------
+    # Prefill: ring attention, collect sharded prefix KV
+    # ------------------------------------------------------------------
+
+    def _build_prefill(self, t_pad: int):
+        cfg, spec, axis = self.cfg, self.spec, self.axis
+        mesh = self.mesh
+        in_spec = (P(),                                    # params (replicated)
+                   P(None, axis) if spec.is_first else P(None, axis, None))
+        out_spec = (P(None, axis, None),                   # hidden
+                    P(None, None, axis),                   # k [L,B,C,...]
+                    P(None, None, axis))                   # v
+
+        @jax.jit
+        @partial(jax.shard_map, mesh=mesh, in_specs=in_spec,
+                 out_specs=out_spec)
+        def fn(params, x):
+            idx = jax.lax.axis_index(axis)
+            c = x.shape[1]
+            b = x.shape[0]
+            positions = jnp.broadcast_to(
+                idx * c + jnp.arange(c, dtype=jnp.int32)[None, :], (b, c))
+            if spec.is_first:
+                h = embed_tokens(cfg, params["embed"], x, positions)
+            else:
+                h = x
+            rope = make_rope(cfg, positions)
+
+            def layer(h, lp):
+                from ..models.quant import dequant_tree
+
+                lp = dequant_tree(lp)
+                a = _norm(cfg, lp["ln1"], h)
+                q, k, v = _qkv(cfg, lp["attn"], a)
+                if rope is not None:
+                    q = apply_rope(q, *rope)
+                    k = apply_rope(k, *rope)
+                out = ring_attention(q, k, v, axis, q_offset=idx * c)
+                out = out.reshape(h.shape[0], c, -1) @ lp["attn"]["wo"]
+                if "bo" in lp["attn"]:
+                    out = out + lp["attn"]["bo"]
+                h = h + out
+                h = h + _mlp(cfg, lp["mlp"], _norm(cfg, lp["ln2"], h), None)
+                return h, (k, v)
+
+            # NO final_norm here even for the last stage: logits_at's lm_head
+            # applies it (models/transformer.py lm_head = norm + projection);
+            # norming twice diverges for any non-unit norm weights.
+            h, (ks, vs) = jax.lax.scan(layer, h, params["layers"])
+            # ks/vs: [L, B, C, Hkv, Dh] — this device's chunk of the prefix.
+            return h, ks.astype(self.dtype), vs.astype(self.dtype)
+
+        return fn
+
+    def prefill(self, x) -> jnp.ndarray:
+        """Run the span over the (long) prompt. x: int ids [B, T] for the
+        first stage, else hidden [B, T, D]. Returns hidden [B, T, D] (global,
+        sequence-sharded; padded rows trimmed). Restarts the session."""
+        x = jnp.asarray(x)
+        b, t = x.shape[0], x.shape[1]
+        t_pad = -(-t // self.p) * self.p
+        if t_pad != t:
+            padw = ((0, 0), (0, t_pad - t)) + (((0, 0),) if x.ndim == 3 else ())
+            x = jnp.pad(x, padw)
+        x = jax.device_put(
+            x, NamedSharding(self.mesh,
+                             P(None, self.axis) if x.ndim == 2
+                             else P(None, self.axis, None)))
+        if self._prefill_fn is None or self.prefix_pad != t_pad:
+            self._prefill_fn = self._build_prefill(t_pad)
+        h, self.pk, self.pv = self._prefill_fn(self.params, x)
+        self.prefix_pad = t_pad
+        self.prefix_len = t
+        self.tail_len = 0
+        l = max(self.spec.num_layers, 1)
+        shape = (l, b, self.tail_max, self.cfg.num_kv_heads, self.cfg.head_dim)
+        repl = NamedSharding(self.mesh, P())
+        self.tk = jax.device_put(jnp.zeros(shape, self.dtype), repl)
+        self.tv = jax.device_put(jnp.zeros(shape, self.dtype), repl)
+        self._decode_fn = None  # shapes may have changed
+        return h[:, :t]
+
+    # ------------------------------------------------------------------
+    # Decode: replicated token, sharded-prefix + replicated-tail attention
+    # ------------------------------------------------------------------
+
+    def _build_decode(self):
+        cfg, spec, axis = self.cfg, self.spec, self.axis
+        mesh = self.mesh
+        seq_spec = P(None, None, axis)
+        in_spec = (P(),                                     # params
+                   P(None, None) if spec.is_first else P(),  # x (replicated)
+                   seq_spec, seq_spec,                      # prefix k/v
+                   P(), P(),                                # tail k/v
+                   P(), P(), P())                           # prefix_len, tail_len, pos
+        out_spec = (P(), P(), P())                          # h, tail k, tail v
+
+        @jax.jit
+        @partial(jax.shard_map, mesh=mesh, in_specs=in_spec,
+                 out_specs=out_spec)
+        def fn(params, x, pk, pv, tk, tv, prefix_len, tail_len, pos):
+            idx = jax.lax.axis_index(axis)
+            b = x.shape[0]
+            positions = jnp.full((b, 1), pos, jnp.int32)
+            if spec.is_first:
+                h = embed_tokens(cfg, params["embed"], x, positions)
+            else:
+                h = x
+            rope = make_rope(cfg, positions)
+            c = pk.shape[2]                                  # prefix chunk
+            scale = cfg.head_dim ** -0.5
+            groups = cfg.num_heads // cfg.num_kv_heads
+
+            def layer(h, lp):
+                from ..models.quant import dequant_tree
+
+                lp, (pk_l, pv_l, tk_l, tv_l) = lp
+                lp = dequant_tree(lp)
+                a = _norm(cfg, lp["ln1"], h)
+                q, k, v = _qkv(cfg, lp["attn"], a)           # [B,1,H/Hkv,Dh]
+                if rope is not None:
+                    q = apply_rope(q, *rope)
+                    k = apply_rope(k, *rope)
+                # Append to the tail (replicated write, same on every device).
+                tk_n = jax.lax.dynamic_update_slice_in_dim(
+                    tk_l, k.astype(tk_l.dtype), tail_len, axis=1)
+                tv_n = jax.lax.dynamic_update_slice_in_dim(
+                    tv_l, v.astype(tv_l.dtype), tail_len, axis=1)
+
+                qg = q.reshape(b, 1, cfg.num_kv_heads, groups, cfg.head_dim)
+                # Partial over MY prefix shard (positions idx*c + j).
+                ppos = idx * c + jnp.arange(c, dtype=jnp.int32)
+                pmask = jnp.broadcast_to((ppos < prefix_len)[None, :], (b, c))
+                part = _masked_partial(qg, pk_l.astype(q.dtype),
+                                       pv_l.astype(q.dtype), pmask, scale)
+                # Log-sum-exp combine across the mesh.
+                m, l, o = part
+                mg = jax.lax.pmax(m, axis)
+                safe = jnp.where(mg <= NEG_INF / 2, 0.0, mg)
+                corr = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - safe))
+                lg = jax.lax.psum(l * corr, axis)
+                og = jax.lax.psum(o * corr[..., None], axis)
+                # Tail partial (identical on every device; includes the token
+                # just written at index tail_len).
+                tpos = jnp.arange(tk_l.shape[1], dtype=jnp.int32)
+                tmask = jnp.broadcast_to((tpos <= tail_len)[None, :],
+                                         (b, tk_l.shape[1]))
+                tpart = _masked_partial(qg, tk_n.astype(q.dtype),
+                                        tv_n.astype(q.dtype), tmask, scale)
+                m2, l2, o2 = _combine((mg, lg, og), tpart)
+                out = (o2 / jnp.maximum(l2, 1e-20)[..., None]).astype(h.dtype)
+                out = out.reshape(b, 1, -1) @ lp["attn"]["wo"]
+                if "bo" in lp["attn"]:
+                    out = out + lp["attn"]["bo"]
+                h = h + out
+                h = h + _mlp(cfg, lp["mlp"], _norm(cfg, lp["ln2"], h), None)
+                return h, (tk_n, tv_n)
+
+            # No final_norm: lm_head (logits_at) owns it — see prefill.
+            h, (tks, tvs) = jax.lax.scan(
+                layer, h, (params["layers"], (pk, pv, tk, tv)))
+            return h, tks, tvs
+
+        return fn
+
+    def decode(self, x) -> jnp.ndarray:
+        """One decode step. x: int ids [B, 1] for the first stage, else
+        hidden [B, 1, D]. Returns hidden [B, 1, D]; appends to the tail."""
+        if self.pk is None:
+            raise RuntimeError("decode before prefill")
+        if self.tail_len >= self.tail_max:
+            raise RuntimeError(
+                f"tail cache full ({self.tail_max}); re-prefill to fold the "
+                "tail into the sharded prefix")
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+        x = jnp.asarray(x)
+        h, self.tk, self.tv = self._decode_fn(
+            self.params, x, self.pk, self.pv, self.tk, self.tv,
+            jnp.int32(self.prefix_len), jnp.int32(self.tail_len),
+            jnp.int32(self.cache_len))
+        self.tail_len += 1
+        return h
+
+    # ------------------------------------------------------------------
+
+    def logits_at(self, hidden: jnp.ndarray, position: int) -> jnp.ndarray:
+        """lm_head over ONE position of a (possibly sequence-sharded) hidden
+        — for long prompts, materializing [B, T, V] logits would dwarf the
+        memory the sharded cache saved."""
+        from ..models.transformer import lm_head
+
+        h = jax.lax.dynamic_slice_in_dim(hidden, position, 1, axis=1)
+        return lm_head(self.cfg, self.params, h)[:, 0]
