@@ -143,6 +143,22 @@ def _psum_if(x: jnp.ndarray, tp_axis: Optional[str]) -> jnp.ndarray:
     return jax.lax.psum(x, tp_axis) if tp_axis is not None else x
 
 
+def qkv_proj(cfg: ModelConfig, p: Params, x: jnp.ndarray):
+    """Attention projections (+ optional q/k/v biases), reshaped to heads.
+    x: [B, T, D] -> q [B, T, H, Dh], k/v [B, T, Hkv, Dh]. The ONE place the
+    projection layout lives — the cached, sequence-parallel, and batched
+    engines all import it."""
+    b, t, _ = x.shape
+    dh = cfg.head_dim
+    q = x @ p["wq"]
+    k = x @ p["wk"]
+    v = x @ p["wv"]
+    if "bq" in p:
+        q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+    return (q.reshape(b, t, -1, dh), k.reshape(b, t, -1, dh),
+            v.reshape(b, t, -1, dh))
+
+
 def _mlp(cfg: ModelConfig, p: Params, x: jnp.ndarray, tp_axis: Optional[str]) -> jnp.ndarray:
     if cfg.is_moe:
         return _moe_mlp(cfg, p, x, tp_axis)
@@ -222,16 +238,8 @@ def _attention(
     position 0), nothing persisted."""
     b, t, _ = x.shape
     dh = cfg.head_dim
-    q = x @ p["wq"]
-    k = x @ p["wk"]
-    v = x @ p["wv"]
-    if "bq" in p:
-        q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
-    h_local = q.shape[-1] // dh
-    hkv_local = k.shape[-1] // dh
-    q = q.reshape(b, t, h_local, dh)
-    k = k.reshape(b, t, hkv_local, dh)
-    v = v.reshape(b, t, hkv_local, dh)
+    q, k, v = qkv_proj(cfg, p, x)
+    h_local = q.shape[2]
 
     if rope is not None:
         cos, sin = rope

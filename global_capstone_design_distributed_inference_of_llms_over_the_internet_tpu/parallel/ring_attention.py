@@ -40,6 +40,40 @@ def _block_scores(q, k, scale):
     )
 
 
+# ---------------------------------------------------------------------------
+# Shared online-softmax primitives (used here conceptually and directly by
+# parallel.sp_stage's decode): partials are (m, l, o) with o UN-normalized
+# fp32. The NEG_INF/2 guards keep fully-masked blocks exactly zero instead
+# of exp(-inf - -inf) = 1 garbage.
+# ---------------------------------------------------------------------------
+
+def online_partial(qg, k, v, mask, scale):
+    """Partial over one KV block. qg: [B, 1, Hkv, G, Dh]; k/v: [B, S, Hkv,
+    Dh]; mask: [B, S] (True = attendable). Returns (m, l, o), o [B,Hkv,G,Dh]."""
+    scores = jnp.einsum("bthgd,bshd->bhgs", qg * scale, k,
+                        preferred_element_type=jnp.float32)
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)
+    safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    probs = jnp.exp(scores - safe_m[..., None])
+    probs = jnp.where(scores <= NEG_INF / 2, 0.0, probs)
+    l = probs.sum(axis=-1)
+    o = jnp.einsum("bhgs,bshd->bhgd", probs.astype(jnp.float32),
+                   v.astype(jnp.float32))
+    return m, l, o
+
+
+def online_combine(a, b):
+    """Merge two online-softmax partials (m, l, o)."""
+    ma, la, oa = a
+    mb, lb, ob = b
+    m = jnp.maximum(ma, mb)
+    safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    ca = jnp.where(ma <= NEG_INF / 2, 0.0, jnp.exp(ma - safe_m))
+    cb = jnp.where(mb <= NEG_INF / 2, 0.0, jnp.exp(mb - safe_m))
+    return m, la * ca + lb * cb, oa * ca[..., None] + ob * cb[..., None]
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,

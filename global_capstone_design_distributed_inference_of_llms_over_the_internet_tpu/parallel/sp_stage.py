@@ -40,60 +40,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
 from ..models.partition import StageSpec
-from ..models.transformer import _mlp, _norm, embed_tokens, make_rope
+from ..models.transformer import _mlp, _norm, embed_tokens, make_rope, qkv_proj
 from ..ops.rotary import apply_rope
-from .ring_attention import ring_attention
+from .ring_attention import (
+    NEG_INF,
+    online_combine,
+    online_partial,
+    ring_attention,
+)
 
 Params = Dict[str, Any]
-
-NEG_INF = -1e30
-
-
-def _qkv(cfg: ModelConfig, p: Params, x: jnp.ndarray):
-    """Projections (+ optional biases), reshaped to heads. x: [B, T, D]."""
-    b, t, _ = x.shape
-    dh = cfg.head_dim
-    q = x @ p["wq"]
-    k = x @ p["wk"]
-    v = x @ p["wv"]
-    if "bq" in p:
-        q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
-    return (q.reshape(b, t, -1, dh), k.reshape(b, t, -1, dh),
-            v.reshape(b, t, -1, dh))
-
-
-def _partial_scores(q, k, scale):
-    # q: [B, 1, Hkv, G, Dh]; k: [B, S, Hkv, Dh] -> [B, Hkv, G, S] f32
-    return jnp.einsum("bthgd,bshd->bhgs", q * scale, k,
-                      preferred_element_type=jnp.float32)
-
-
-def _masked_partial(qg, k, v, mask, scale):
-    """Online-softmax partial over one KV block. Returns (m, l, o) with
-    o un-normalized f32 [B, Hkv, G, Dh]."""
-    scores = _partial_scores(qg, k, scale)                     # [B,Hkv,G,S]
-    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
-    m = jnp.max(scores, axis=-1)                               # [B,Hkv,G]
-    safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
-    probs = jnp.exp(scores - safe_m[..., None])
-    probs = jnp.where(scores <= NEG_INF / 2, 0.0, probs)
-    l = probs.sum(axis=-1)
-    o = jnp.einsum("bhgs,bshd->bhgd", probs.astype(jnp.float32),
-                   v.astype(jnp.float32))
-    return m, l, o
-
-
-def _combine(a, b):
-    """Merge two online-softmax partials (m, l, o)."""
-    ma, la, oa = a
-    mb, lb, ob = b
-    m = jnp.maximum(ma, mb)
-    safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
-    ca = jnp.exp(ma - safe_m)
-    cb = jnp.exp(mb - safe_m)
-    ca = jnp.where(ma <= NEG_INF / 2, 0.0, ca)
-    cb = jnp.where(mb <= NEG_INF / 2, 0.0, cb)
-    return m, la * ca + lb * cb, oa * ca[..., None] + ob * cb[..., None]
 
 
 class SpStageRunner:
@@ -149,7 +105,9 @@ class SpStageRunner:
     # Prefill: ring attention, collect sharded prefix KV
     # ------------------------------------------------------------------
 
-    def _build_prefill(self, t_pad: int):
+    def _build_prefill(self):
+        # Built ONCE; jax.jit specializes per input shape, so alternating
+        # prompt lengths each compile once instead of retracing every call.
         cfg, spec, axis = self.cfg, self.spec, self.axis
         mesh = self.mesh
         in_spec = (P(),                                    # params (replicated)
@@ -178,7 +136,7 @@ class SpStageRunner:
 
                 lp = dequant_tree(lp)
                 a = _norm(cfg, lp["ln1"], h)
-                q, k, v = _qkv(cfg, lp["attn"], a)
+                q, k, v = qkv_proj(cfg, lp["attn"], a)
                 if rope is not None:
                     q = apply_rope(q, *rope)
                     k = apply_rope(k, *rope)
@@ -213,8 +171,8 @@ class SpStageRunner:
             x, NamedSharding(self.mesh,
                              P(None, self.axis) if x.ndim == 2
                              else P(None, self.axis, None)))
-        if self._prefill_fn is None or self.prefix_pad != t_pad:
-            self._prefill_fn = self._build_prefill(t_pad)
+        if self._prefill_fn is None:
+            self._prefill_fn = self._build_prefill()
         h, self.pk, self.pv = self._prefill_fn(self.params, x)
         self.prefix_pad = t_pad
         self.prefix_len = t
@@ -224,7 +182,6 @@ class SpStageRunner:
         repl = NamedSharding(self.mesh, P())
         self.tk = jax.device_put(jnp.zeros(shape, self.dtype), repl)
         self.tv = jax.device_put(jnp.zeros(shape, self.dtype), repl)
-        self._decode_fn = None  # shapes may have changed
         return h[:, :t]
 
     # ------------------------------------------------------------------
@@ -242,7 +199,10 @@ class SpStageRunner:
                    P(), P(), P())                           # prefix_len, tail_len, pos
         out_spec = (P(), P(), P())                          # h, tail k, tail v
 
-        @jax.jit
+        # Donate the tail caches (updated every step) so the append is
+        # in-place; the prefix caches are NOT donated — the same buffers are
+        # re-passed for the whole session.
+        @partial(jax.jit, donate_argnums=(4, 5))
         @partial(jax.shard_map, mesh=mesh, in_specs=in_spec,
                  out_specs=out_spec)
         def fn(params, x, pk, pv, tk, tv, prefix_len, tail_len, pos):
@@ -264,7 +224,7 @@ class SpStageRunner:
                 lp, (pk_l, pv_l, tk_l, tv_l) = lp
                 lp = dequant_tree(lp)
                 a = _norm(cfg, lp["ln1"], h)
-                q, k, v = _qkv(cfg, lp["attn"], a)           # [B,1,H/Hkv,Dh]
+                q, k, v = qkv_proj(cfg, lp["attn"], a)           # [B,1,H/Hkv,Dh]
                 if rope is not None:
                     q = apply_rope(q, *rope)
                     k = apply_rope(k, *rope)
@@ -278,8 +238,8 @@ class SpStageRunner:
                 # Partial over MY prefix shard (positions idx*c + j).
                 ppos = idx * c + jnp.arange(c, dtype=jnp.int32)
                 pmask = jnp.broadcast_to((ppos < prefix_len)[None, :], (b, c))
-                part = _masked_partial(qg, pk_l.astype(q.dtype),
-                                       pv_l.astype(q.dtype), pmask, scale)
+                part = online_partial(qg, pk_l.astype(q.dtype),
+                                      pv_l.astype(q.dtype), pmask, scale)
                 # Log-sum-exp combine across the mesh.
                 m, l, o = part
                 mg = jax.lax.pmax(m, axis)
@@ -292,9 +252,9 @@ class SpStageRunner:
                 tpos = jnp.arange(tk_l.shape[1], dtype=jnp.int32)
                 tmask = jnp.broadcast_to((tpos <= tail_len)[None, :],
                                          (b, tk_l.shape[1]))
-                tpart = _masked_partial(qg, tk_n.astype(q.dtype),
-                                        tv_n.astype(q.dtype), tmask, scale)
-                m2, l2, o2 = _combine((mg, lg, og), tpart)
+                tpart = online_partial(qg, tk_n.astype(q.dtype),
+                                       tv_n.astype(q.dtype), tmask, scale)
+                m2, l2, o2 = online_combine((mg, lg, og), tpart)
                 out = (o2 / jnp.maximum(l2, 1e-20)[..., None]).astype(h.dtype)
                 out = out.reshape(b, 1, -1) @ lp["attn"]["wo"]
                 if "bo" in lp["attn"]:

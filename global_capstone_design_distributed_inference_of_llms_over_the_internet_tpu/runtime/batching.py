@@ -1,0 +1,298 @@
+"""Continuous batching: many concurrent sessions, ONE decode step.
+
+The reference serves each session's decode step as its own forward
+(``src/rpc_handler.py:149-325`` — one request, one compute); N concurrent
+clients cost N sequential forwards per token. On TPU the idiomatic fix is
+STATIC-SHAPE slot batching (the shape-stable cousin of vLLM-style
+continuous batching): the server owns one slot-major KV cache
+``[L, S, max_len, Hkv, Dh]``, every live session occupies a slot, and one
+jitted step advances EVERY active slot at once — per-slot cache lengths, an
+active mask for empty slots, zero gathers/copies of cache rows. Compute
+scales with the slot count S (the server's intended concurrency), not with
+how many requests happen to arrive, and the step is one compiled program
+replayed forever.
+
+Sessions join at prefill (slot allocated, prompt written into the slot's
+rows), decode via `decode_batch` (whatever subset of sessions has a token
+ready — inactive slots are masked), and leave via `end_session` (slot
+recycled). Token parity with the per-session oracle is asserted in
+tests/test_batching.py.
+
+Scope: the batched path covers plain greedy/sampled decode. Beam reorder,
+speculative drafts, and replay ride the per-session StageExecutor —
+servers route those requests to it unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.config import ModelConfig
+from ..models.partition import StageSpec
+from ..models.transformer import _mlp, _norm, embed_tokens, make_rope, qkv_proj
+from ..ops.rotary import apply_rope
+from ..parallel.ring_attention import NEG_INF
+from .kv_cache import round_to_bucket
+
+Params = Dict[str, Any]
+
+PREFILL_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+class SlotFull(RuntimeError):
+    """No free slot (admission control — the caller queues or fails over)."""
+
+
+class BatchedStageExecutor:
+    """One stage span serving up to `slots` sessions with batched decode."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        spec: StageSpec,
+        params: Params,
+        *,
+        slots: int = 8,
+        max_len: int = 2048,
+        dtype=jnp.float32,
+    ):
+        if cfg.sliding_window:
+            raise ValueError("batched serving is causal-only for now")
+        self.cfg = cfg
+        self.spec = spec
+        self.params = params
+        self.slots = slots
+        self.max_len = max_len
+        self.dtype = jnp.dtype(dtype)
+        l = max(spec.num_layers, 1)
+        shape = (l, slots, max_len, cfg.num_kv_heads, cfg.head_dim)
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+        self.lengths = np.zeros((slots,), np.int32)   # host-side truth
+        self._slot_of: Dict[str, int] = {}
+        self._free: List[int] = list(range(slots))
+        self.decode_steps = 0                          # batched steps executed
+        self._prefill_jit = None
+        self._decode_jit = None
+
+    # ------------------------------------------------------------------
+    # Slots
+    # ------------------------------------------------------------------
+
+    def slot(self, session_id: str) -> Optional[int]:
+        return self._slot_of.get(session_id)
+
+    def _alloc(self, session_id: str) -> int:
+        old = self._slot_of.pop(session_id, None)
+        if old is not None:                  # re-prefill restarts the session
+            self._free.append(old)
+        if not self._free:
+            raise SlotFull(f"all {self.slots} session slots in use")
+        s = self._free.pop()
+        self._slot_of[session_id] = s
+        return s
+
+    def end_session(self, session_id: str) -> None:
+        s = self._slot_of.pop(session_id, None)
+        if s is not None:
+            self.lengths[s] = 0
+            self._free.append(s)
+
+    # ------------------------------------------------------------------
+    # Prefill: per-session, writes the prompt's KV into the slot's rows
+    # ------------------------------------------------------------------
+
+    def _build_prefill(self):
+        cfg, spec = self.cfg, self.spec
+
+        @partial(jax.jit, donate_argnums=(3, 4))
+        def fn(params, x, slot, k_all, v_all, t_real):
+            b = 1
+            t = x.shape[1]
+            positions = jnp.arange(t, dtype=jnp.int32)[None, :]
+            h = (embed_tokens(cfg, params["embed"], x, positions)
+                 if spec.is_first else x)
+            rope = make_rope(cfg, positions)
+            # Causal self-attention over the fresh prompt (prefill restarts
+            # the session, so there is no prior cache to attend to). O(T^2)
+            # scores — long prompts belong to the sp engine or the chunked
+            # per-session executor.
+            causal = jnp.tril(jnp.ones((t, t), bool))
+            valid = jnp.arange(t)[None, :] < t_real       # mask pad columns
+            mask = causal & valid
+
+            def layer(h, lp):
+                from ..models.quant import dequant_tree
+
+                lp = dequant_tree(lp)
+                a = _norm(cfg, lp["ln1"], h)
+                q, k, v = qkv_proj(cfg, lp["attn"], a)
+                if rope is not None:
+                    q = apply_rope(q, *rope)
+                    k = apply_rope(k, *rope)
+                groups = cfg.num_heads // cfg.num_kv_heads
+                qg = q.reshape(b, t, cfg.num_kv_heads, groups, cfg.head_dim)
+                scores = jnp.einsum(
+                    "bthgd,bshd->bhgts", qg * cfg.head_dim ** -0.5, k,
+                    preferred_element_type=jnp.float32)
+                scores = jnp.where(mask[None, None, None], scores, NEG_INF)
+                probs = jax.nn.softmax(scores, axis=-1)
+                out = jnp.einsum("bhgts,bshd->bthgd",
+                                 probs.astype(v.dtype), v)
+                out = out.reshape(b, t, -1) @ lp["attn"]["wo"]
+                if "bo" in lp["attn"]:
+                    out = out + lp["attn"]["bo"]
+                h = h + out
+                h = h + _mlp(cfg, lp["mlp"], _norm(cfg, lp["ln2"], h), None)
+                return h, (k[0], v[0])
+
+            h, (ks, vs) = jax.lax.scan(layer, h, params["layers"])
+            # ks/vs: [L, T, Hkv, Dh] -> write rows [slot, 0:T).
+            k_all = jax.lax.dynamic_update_slice(
+                k_all, ks[:, None].astype(k_all.dtype),
+                (0, slot, 0, 0, 0))
+            v_all = jax.lax.dynamic_update_slice(
+                v_all, vs[:, None].astype(v_all.dtype),
+                (0, slot, 0, 0, 0))
+            return h, k_all, v_all
+
+        return fn
+
+    def prefill(self, session_id: str, x) -> jnp.ndarray:
+        """Join/restart a session: x = ids [1, T] (first stage) or hidden
+        [1, T, D]. Returns hidden [1, T, D] (pad rows trimmed)."""
+        x = jnp.asarray(x)
+        t = x.shape[1]
+        if t > self.max_len:
+            raise ValueError(f"prompt {t} exceeds slot max_len {self.max_len}")
+        s = self._alloc(session_id)
+        # Bucket-pad the prompt so an epoch of varied lengths compiles a
+        # handful of shapes; beyond the bucket table, exact length (one
+        # compile) beats failing.
+        tb = (t if t > PREFILL_BUCKETS[-1]
+              else min(round_to_bucket(t, PREFILL_BUCKETS), self.max_len))
+        if tb != t:
+            pad = ((0, 0), (0, tb - t)) + (((0, 0),) if x.ndim == 3 else ())
+            x = jnp.pad(x, pad)
+        if self._prefill_jit is None:
+            self._prefill_jit = self._build_prefill()
+        h, self.k, self.v = self._prefill_jit(
+            self.params, x, jnp.int32(s), self.k, self.v, jnp.int32(t))
+        self.lengths[s] = t
+        return h[:, :t]
+
+    # ------------------------------------------------------------------
+    # Batched decode: one step for EVERY active slot
+    # ------------------------------------------------------------------
+
+    def _build_decode(self):
+        cfg, spec = self.cfg, self.spec
+        S = self.slots
+
+        @partial(jax.jit, donate_argnums=(4, 5))
+        def fn(params, x, lengths, active, k_all, v_all):
+            # x: ids [S, 1] or hidden [S, 1, D]; lengths/active: [S].
+            positions = lengths[:, None]                       # [S, 1]
+            h = (embed_tokens(cfg, params["embed"], x, positions)
+                 if spec.is_first else x)
+            rope = make_rope(cfg, positions)
+            groups = cfg.num_heads // cfg.num_kv_heads
+            pos_grid = jnp.arange(k_all.shape[2], dtype=jnp.int32)  # [max_len]
+
+            def layer(h, lp_kv):
+                lp, (k_l, v_l) = lp_kv                 # k_l: [S,max_len,Hkv,Dh]
+                from ..models.quant import dequant_tree
+
+                lp = dequant_tree(lp)
+                a = _norm(cfg, lp["ln1"], h)
+                q, k, v = qkv_proj(cfg, lp["attn"], a)     # [S,1,H/Hkv,Dh]
+                if rope is not None:
+                    q = apply_rope(q, *rope)
+                    k = apply_rope(k, *rope)
+                # Per-slot cache write at each slot's own length (vmap'd
+                # dynamic_update_slice; inactive slots write at their stale
+                # length and are masked out of attention AND never have
+                # their host-side length advanced, so the row is dead).
+                upd = jax.vmap(
+                    lambda cache, new, start:
+                    jax.lax.dynamic_update_slice_in_dim(cache, new, start, 0)
+                )
+                k_l = upd(k_l, k.astype(k_l.dtype), lengths)
+                v_l = upd(v_l, v.astype(v_l.dtype), lengths)
+                # Attention over [0, length] (inclusive of the new token).
+                qg = q.reshape(S, 1, cfg.num_kv_heads, groups, cfg.head_dim)
+                scores = jnp.einsum(
+                    "bthgd,bshd->bhgts", qg * cfg.head_dim ** -0.5,
+                    k_l.astype(q.dtype),
+                    preferred_element_type=jnp.float32)      # [S,Hkv,G,1,M]
+                allowed = pos_grid[None, :] <= lengths[:, None]   # [S, M]
+                scores = jnp.where(allowed[:, None, None, None], scores,
+                                   NEG_INF)
+                probs = jax.nn.softmax(scores, axis=-1)
+                out = jnp.einsum("bhgts,bshd->bthgd",
+                                 probs.astype(v_l.dtype),
+                                 v_l.astype(q.dtype))
+                out = out.reshape(S, 1, -1) @ lp["attn"]["wo"]
+                if "bo" in lp["attn"]:
+                    out = out + lp["attn"]["bo"]
+                h = h + out
+                h = h + _mlp(cfg, lp["mlp"], _norm(cfg, lp["ln2"], h), None)
+                return h, (k_l, v_l)
+
+            h, (k_all, v_all) = jax.lax.scan(
+                layer, h, (params["layers"], (k_all, v_all)))
+            # Inactive slots produced garbage — zero them so nothing
+            # downstream can mistake them for real activations.
+            h = jnp.where(active[:, None, None], h, 0.0)
+            return h, k_all, v_all
+
+        return fn
+
+    def decode_batch(self, inputs: Dict[str, Any]) -> Dict[str, jnp.ndarray]:
+        """One batched step. inputs: {session_id: ids [1,1] or hidden
+        [1,1,D]}. Returns {session_id: hidden [1,1,D]}. Sessions not in
+        `inputs` are untouched (masked)."""
+        if not inputs:
+            return {}
+        sids = list(inputs)
+        rows = []
+        for sid in sids:
+            if sid not in self._slot_of:
+                raise KeyError(f"unknown session {sid} (prefill first)")
+            if self.lengths[self._slot_of[sid]] >= self.max_len:
+                raise RuntimeError(f"session {sid} at max_len {self.max_len}")
+            rows.append(self._slot_of[sid])
+
+        first = self.spec.is_first
+        d = self.cfg.hidden_size
+        if first:
+            x = np.zeros((self.slots, 1), np.int32)
+        else:
+            x = np.zeros((self.slots, 1, d), np.float32)
+        for sid, s in zip(sids, rows):
+            x[s] = np.asarray(inputs[sid])[0]
+        active = np.zeros((self.slots,), bool)
+        active[rows] = True
+
+        if self._decode_jit is None:
+            self._decode_jit = self._build_decode()
+        h, self.k, self.v = self._decode_jit(
+            self.params, jnp.asarray(x), jnp.asarray(self.lengths),
+            jnp.asarray(active), self.k, self.v)
+        for s in rows:
+            self.lengths[s] += 1
+        self.decode_steps += 1
+        return {sid: h[s:s + 1] for sid, s in zip(sids, rows)}
+
+    # ------------------------------------------------------------------
+
+    def logits(self, hidden: jnp.ndarray) -> jnp.ndarray:
+        """Final-stage head over [1, T, D] -> [1, T, V] (fp32)."""
+        from ..models.transformer import lm_head
+
+        return lm_head(self.cfg, self.params, hidden)

@@ -1,0 +1,195 @@
+"""Continuous batching (runtime.batching): N concurrent sessions, one
+decode step — token-identical to per-session decoding.
+
+The reference computes one forward per session per token
+(src/rpc_handler.py:149-325); the batched executor advances every active
+slot in one jitted step over a slot-major KV cache.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+    full_forward,
+    init_kv_cache,
+    init_params,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.partition import (
+    ROLE_FULL,
+    StagePlan,
+    StageSpec,
+    parse_splits,
+    slice_stage_params,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.batching import (
+    BatchedStageExecutor,
+    SlotFull,
+)
+
+from test_runtime_pipeline import tiny_cfg
+
+
+def full_spec(cfg):
+    return StageSpec(index=0, role=ROLE_FULL, start=0, end=cfg.num_layers)
+
+
+def oracle_tokens(cfg, params, prompt, n_new, max_len=128):
+    kc, vc = init_kv_cache(cfg, cfg.num_layers, 1, max_len)
+    ids = jnp.asarray(np.asarray(prompt, np.int32)[None, :])
+    logits, kc, vc = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
+    out = [int(jnp.argmax(logits[0, -1]))]
+    cur = len(prompt)
+    for _ in range(n_new - 1):
+        logits, kc, vc = full_forward(
+            cfg, params, jnp.asarray([[out[-1]]], jnp.int32), kc, vc,
+            jnp.int32(cur))
+        out.append(int(jnp.argmax(logits[0, -1])))
+        cur += 1
+    return out
+
+
+PROMPTS = {
+    "a": [5, 9, 23, 7, 81],
+    "b": [44, 2, 3],
+    "c": [100, 11, 12, 13, 14, 15, 16],
+    "d": [7, 7, 9],
+}
+
+
+def batched_generate(ex, prompts, n_new):
+    """Drive all sessions together through the batched engine (greedy)."""
+    toks = {}
+    for sid, prompt in prompts.items():
+        h = ex.prefill(sid, np.asarray(prompt, np.int32)[None, :])
+        toks[sid] = [int(jnp.argmax(ex.logits(h)[0, -1]))]
+    for _ in range(n_new - 1):
+        inputs = {sid: jnp.asarray([[toks[sid][-1]]], jnp.int32)
+                  for sid in prompts}
+        outs = ex.decode_batch(inputs)
+        for sid, h in outs.items():
+            toks[sid].append(int(jnp.argmax(ex.logits(h)[0, -1])))
+    return toks
+
+
+@pytest.mark.parametrize("family", ["llama", "gpt2", "qwen2"])
+def test_batched_sessions_match_per_session_oracle(family):
+    cfg = tiny_cfg(family)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ex = BatchedStageExecutor(cfg, full_spec(cfg), params,
+                              slots=4, max_len=64)
+    n_new = 6
+    got = batched_generate(ex, PROMPTS, n_new)
+    for sid, prompt in PROMPTS.items():
+        assert got[sid] == oracle_tokens(cfg, params, prompt, n_new), sid
+    # The whole point: n_new-1 batched steps TOTAL, not per session.
+    assert ex.decode_steps == n_new - 1
+
+
+def test_sessions_join_and_leave_mid_stream():
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    ex = BatchedStageExecutor(cfg, full_spec(cfg), params,
+                              slots=2, max_len=64)
+    pa, pb, pc = PROMPTS["a"], PROMPTS["b"], PROMPTS["c"]
+    ra = oracle_tokens(cfg, params, pa, 6)
+    rb = oracle_tokens(cfg, params, pb, 3)
+    rc = oracle_tokens(cfg, params, pc, 4)
+
+    ha = ex.prefill("a", np.asarray(pa, np.int32)[None, :])
+    ta = [int(jnp.argmax(ex.logits(ha)[0, -1]))]
+    hb = ex.prefill("b", np.asarray(pb, np.int32)[None, :])
+    tb = [int(jnp.argmax(ex.logits(hb)[0, -1]))]
+    # Two steps together.
+    for _ in range(2):
+        outs = ex.decode_batch({
+            "a": jnp.asarray([[ta[-1]]], jnp.int32),
+            "b": jnp.asarray([[tb[-1]]], jnp.int32)})
+        ta.append(int(jnp.argmax(ex.logits(outs["a"])[0, -1])))
+        tb.append(int(jnp.argmax(ex.logits(outs["b"])[0, -1])))
+    assert tb == rb
+    # b leaves, c takes its slot (slots=2 -> c REUSES b's slot), a continues.
+    ex.end_session("b")
+    hc = ex.prefill("c", np.asarray(pc, np.int32)[None, :])
+    tc = [int(jnp.argmax(ex.logits(hc)[0, -1]))]
+    for _ in range(3):
+        outs = ex.decode_batch({
+            "a": jnp.asarray([[ta[-1]]], jnp.int32),
+            "c": jnp.asarray([[tc[-1]]], jnp.int32)})
+        ta.append(int(jnp.argmax(ex.logits(outs["a"])[0, -1])))
+        tc.append(int(jnp.argmax(ex.logits(outs["c"])[0, -1])))
+    assert ta == ra
+    assert tc == rc
+
+
+def test_partial_batches_and_stragglers():
+    # Sessions decode at different cadences; a step may carry any subset.
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    ex = BatchedStageExecutor(cfg, full_spec(cfg), params,
+                              slots=4, max_len=64)
+    pa, pb = PROMPTS["a"], PROMPTS["b"]
+    ra = oracle_tokens(cfg, params, pa, 5)
+    rb = oracle_tokens(cfg, params, pb, 3)
+    ha = ex.prefill("a", np.asarray(pa, np.int32)[None, :])
+    ta = [int(jnp.argmax(ex.logits(ha)[0, -1]))]
+    hb = ex.prefill("b", np.asarray(pb, np.int32)[None, :])
+    tb = [int(jnp.argmax(ex.logits(hb)[0, -1]))]
+    # a advances alone, then together, then b alone.
+    outs = ex.decode_batch({"a": jnp.asarray([[ta[-1]]], jnp.int32)})
+    ta.append(int(jnp.argmax(ex.logits(outs["a"])[0, -1])))
+    outs = ex.decode_batch({
+        "a": jnp.asarray([[ta[-1]]], jnp.int32),
+        "b": jnp.asarray([[tb[-1]]], jnp.int32)})
+    ta.append(int(jnp.argmax(ex.logits(outs["a"])[0, -1])))
+    tb.append(int(jnp.argmax(ex.logits(outs["b"])[0, -1])))
+    outs = ex.decode_batch({"b": jnp.asarray([[tb[-1]]], jnp.int32)})
+    tb.append(int(jnp.argmax(ex.logits(outs["b"])[0, -1])))
+    assert ta[:5] == ra[:len(ta)] and tb == rb
+
+
+def test_slot_admission_and_reuse():
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    ex = BatchedStageExecutor(cfg, full_spec(cfg), params,
+                              slots=2, max_len=32)
+    ex.prefill("s1", np.asarray([[1, 2, 3]], np.int32))
+    ex.prefill("s2", np.asarray([[4, 5]], np.int32))
+    with pytest.raises(SlotFull):
+        ex.prefill("s3", np.asarray([[6]], np.int32))
+    ex.end_session("s1")
+    ex.prefill("s3", np.asarray([[6]], np.int32))     # reuses s1's slot
+    # Re-prefilling an EXISTING session must not leak its slot.
+    ex.prefill("s3", np.asarray([[6, 7]], np.int32))
+    assert ex.slot("s3") is not None
+
+
+def test_batched_stage_pipeline_matches_oracle():
+    """Two batched stage executors chained as pipeline hops: batched decode
+    composes with staged serving (hidden rows flow per session)."""
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(5), cfg)
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("4"))
+    s0 = BatchedStageExecutor(cfg, plan.stages[0],
+                              slice_stage_params(cfg, params, plan.stages[0]),
+                              slots=4, max_len=64)
+    s1 = BatchedStageExecutor(cfg, plan.stages[1],
+                              slice_stage_params(cfg, params, plan.stages[1]),
+                              slots=4, max_len=64)
+    prompts = {"a": PROMPTS["a"], "b": PROMPTS["b"]}
+    n_new = 5
+    toks = {}
+    for sid, prompt in prompts.items():
+        h0 = s0.prefill(sid, np.asarray(prompt, np.int32)[None, :])
+        h1 = s1.prefill(sid, h0)
+        toks[sid] = [int(jnp.argmax(s1.logits(h1)[0, -1]))]
+    for _ in range(n_new - 1):
+        ins0 = {sid: jnp.asarray([[toks[sid][-1]]], jnp.int32)
+                for sid in prompts}
+        mid = s0.decode_batch(ins0)
+        outs = s1.decode_batch(mid)
+        for sid, h in outs.items():
+            toks[sid].append(int(jnp.argmax(s1.logits(h)[0, -1])))
+    for sid, prompt in prompts.items():
+        assert toks[sid] == oracle_tokens(cfg, params, prompt, n_new), sid
