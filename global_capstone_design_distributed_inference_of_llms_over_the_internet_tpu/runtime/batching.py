@@ -25,6 +25,8 @@ servers route those requests to it unchanged.
 
 from __future__ import annotations
 
+import threading
+import time
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -296,3 +298,174 @@ class BatchedStageExecutor:
         from ..models.transformer import lm_head
 
         return lm_head(self.cfg, self.params, hidden)
+
+
+# ---------------------------------------------------------------------------
+# Transport adapter: serve the batched engine behind the StageRequest
+# protocol, coalescing CONCURRENT decode requests into one step.
+# ---------------------------------------------------------------------------
+
+class _Round:
+    """One coalescing window: requests that arrive while it is open share a
+    single batched step."""
+
+    __slots__ = ("reqs", "outs", "err", "bad", "lengths", "event", "closed")
+
+    def __init__(self):
+        self.reqs: Dict[str, Any] = {}
+        self.outs: Dict[str, jnp.ndarray] = {}
+        self.lengths: Dict[str, int] = {}
+        self.err: Optional[Exception] = None      # whole-round failure
+        self.bad: Dict[str, str] = {}             # per-session exclusions
+        self.event = threading.Event()
+        self.closed = False
+
+
+class BatchingStageAdapter:
+    """Drop-in StageExecutor replacement for transports: plain
+    prefill/decode requests ride the batched engine, with concurrent decode
+    calls coalesced — the FIRST arrival leads the round, waits
+    ``window_s`` for followers, runs ONE `decode_batch`, and every waiter
+    picks up its own row. Beam/speculative/training/replay/sub-span
+    requests are refused with a retryable stage error so clients route them
+    to a per-session replica (the batched path is the common-case fast
+    lane, not the whole protocol — see module docstring)."""
+
+    def __init__(self, inner: BatchedStageExecutor, *,
+                 window_s: float = 0.003, peer_id: str = "batched",
+                 step_timeout: float = 120.0):
+        self.inner = inner
+        self.spec = inner.spec
+        self.cfg = inner.cfg
+        self.window_s = window_s
+        self.peer_id = peer_id
+        self.step_timeout = step_timeout
+        self._lock = threading.Lock()
+        self._round: Optional[_Round] = None
+
+    # -- protocol ----------------------------------------------------------
+
+    def forward(self, req) -> "StageResponse":
+        from .executor import StageExecutionError
+
+        if (req.train or req.hypo_ids is not None or req.num_logprobs
+                or req.draft_tokens is not None or req.is_replay
+                or req.start_from_position not in (None, req.cur_len)):
+            raise StageExecutionError(
+                "batched peer serves plain prefill/decode only "
+                "(route beam/speculative/replay to a per-session replica)")
+        if req.start_block is not None and (
+                req.start_block != self.spec.start
+                or (req.end_block or self.spec.end) != self.spec.end):
+            raise StageExecutionError(
+                "batched peer serves its full span only")
+        if req.is_prefill:
+            return self._prefill(req)
+        if req.seq_len != 1:
+            raise StageExecutionError(
+                "batched decode is single-token (chunked continuation "
+                "belongs to the per-session executor)")
+        return self._decode(req)
+
+    def drop_session(self, session_id: str) -> None:
+        with self._lock:
+            self.inner.end_session(session_id)
+
+    # -- phases ------------------------------------------------------------
+
+    def _respond(self, req, hidden_row, cache_len: int):
+        from .executor import _sample_last
+        from .messages import StageResponse
+
+        if self.spec.is_last:
+            logits = self.inner.logits(hidden_row)
+            token = _sample_last(logits, hidden_row.shape[1], req)
+            return StageResponse(session_id=req.session_id, token_id=token,
+                                 cache_len=cache_len)
+        return StageResponse(session_id=req.session_id, hidden=hidden_row,
+                             cache_len=cache_len)
+
+    def _prefill(self, req):
+        from .executor import StageExecutionError
+
+        with self._lock:  # slot tables + cache arrays are shared state
+            try:
+                h = self.inner.prefill(req.session_id, req.hidden)
+            except (SlotFull, ValueError) as exc:
+                raise StageExecutionError(str(exc)) from exc
+            cache_len = int(self.inner.lengths[self.inner.slot(req.session_id)])
+        return self._respond(req, h, cache_len)
+
+    def _validate(self, req) -> Optional[str]:
+        """Per-session admission (caller holds the lock). Returns a refusal
+        reason or None. A bad session must never poison its round-mates."""
+        s = self.inner.slot(req.session_id)
+        if s is None:
+            return (f"session {req.session_id}: decode without a slot "
+                    "(prefill first; replay-rebuild is per-session only)")
+        cur = int(self.inner.lengths[s])
+        if cur >= self.inner.max_len:
+            return f"session {req.session_id} at max_len {self.inner.max_len}"
+        if req.cur_len != cur:
+            # The per-session executor warns and trusts itself
+            # (executor.py past-len mismatch); the batched path REFUSES: the
+            # main cause here is a retry after a follower timeout whose step
+            # actually advanced — continuing would silently desync. Refusal
+            # is retryable, so the client fails over to a per-session
+            # replica and replays.
+            return (f"session {req.session_id}: cur_len {req.cur_len} != "
+                    f"server {cur} (stale retry?)")
+        return None
+
+    def _decode(self, req):
+        from .executor import StageExecutionError
+
+        sid = req.session_id
+        with self._lock:
+            reason = self._validate(req)
+            if reason is not None:
+                raise StageExecutionError(reason)
+            r = self._round
+            if r is None or r.closed:
+                r = self._round = _Round()
+                leader = True       # explicit: whoever CREATES the round
+            else:
+                leader = False
+            if sid in r.reqs:
+                raise StageExecutionError(
+                    f"session {sid}: concurrent decode for one session")
+            r.reqs[sid] = req
+        if leader:
+            time.sleep(self.window_s)
+            with self._lock:
+                r.closed = True
+                if self._round is r:
+                    self._round = None
+                # Re-validate under the lock: a session may have been
+                # dropped (or otherwise invalidated) since it joined.
+                # Exclusions fail ONLY their own waiter.
+                good = {}
+                for s_id, rq in r.reqs.items():
+                    reason = self._validate(rq)
+                    if reason is None:
+                        good[s_id] = rq
+                    else:
+                        r.bad[s_id] = reason
+                try:
+                    if good:
+                        r.outs = self.inner.decode_batch(
+                            {s_id: rq.hidden for s_id, rq in good.items()})
+                        r.lengths = {
+                            s_id: int(self.inner.lengths[self.inner.slot(s_id)])
+                            for s_id in good
+                        }
+                except Exception as exc:  # whole-round failure
+                    r.err = exc
+            r.event.set()
+        elif not r.event.wait(self.step_timeout):
+            raise StageExecutionError("batched step timed out")
+        if r.err is not None:
+            raise StageExecutionError(str(r.err)) from r.err
+        if sid in r.bad:
+            raise StageExecutionError(r.bad[sid])
+        return self._respond(req, r.outs[sid], r.lengths[sid])

@@ -63,6 +63,30 @@ class StageExecutionError(RuntimeError):
     ``src/rpc_handler.py:198-202`` for decode-without-cache)."""
 
 
+def _sample_last(logits: jnp.ndarray, t_real: int, req: StageRequest) -> int:
+    """Final-stage sampling from the last REAL token's logits, using the
+    metadata-shipped params + recent window (``src/rpc_handler.py:268-307``).
+    Shared by the per-session executor and the batched adapter."""
+    last = logits[0, t_real - 1]  # [V] fp32 (lm_head upcasts)
+    recent = np.zeros((RECENT_WINDOW,), np.int32)
+    n = min(len(req.generated_tokens), RECENT_WINDOW)
+    if n:
+        recent[:n] = np.asarray(req.generated_tokens[-n:], np.int32)
+    sp = req.sampling
+    rng = jax.random.PRNGKey(req.step_seed)
+    token = sample_token(
+        rng,
+        last,
+        jnp.asarray(recent),
+        jnp.asarray(n, jnp.int32),
+        jnp.asarray(sp.temperature, jnp.float32),
+        jnp.asarray(sp.top_p, jnp.float32),
+        jnp.asarray(sp.top_k, jnp.int32),
+        jnp.asarray(sp.repetition_penalty, jnp.float32),
+    )
+    return int(token)
+
+
 class StageExecutor:
     """One pipeline stage's compute engine (one 'server' in reference terms)."""
 
@@ -444,26 +468,7 @@ class StageExecutor:
         )
 
     def _sample(self, logits: jnp.ndarray, t_real: int, req: StageRequest) -> int:
-        """Final-stage sampling from the last REAL token's logits, using the
-        metadata-shipped params + recent window (``src/rpc_handler.py:268-307``)."""
-        last = logits[0, t_real - 1]  # [V] fp32 (lm_head upcasts)
-        recent = np.zeros((RECENT_WINDOW,), np.int32)
-        n = min(len(req.generated_tokens), RECENT_WINDOW)
-        if n:
-            recent[:n] = np.asarray(req.generated_tokens[-n:], np.int32)
-        sp = req.sampling
-        rng = jax.random.PRNGKey(req.step_seed)
-        token = sample_token(
-            rng,
-            last,
-            jnp.asarray(recent),
-            jnp.asarray(n, jnp.int32),
-            jnp.asarray(sp.temperature, jnp.float32),
-            jnp.asarray(sp.top_p, jnp.float32),
-            jnp.asarray(sp.top_k, jnp.int32),
-            jnp.asarray(sp.repetition_penalty, jnp.float32),
-        )
-        return int(token)
+        return _sample_last(logits, t_real, req)
 
     # ------------------------------------------------------------------
     # Fine-tuning path (vendored rpc_forward/rpc_backward training surface,

@@ -165,6 +165,142 @@ def test_slot_admission_and_reuse():
     assert ex.slot("s3") is not None
 
 
+def test_adapter_serves_concurrent_clients_through_transport():
+    """BatchingStageAdapter behind LocalTransport: three clients generate
+    CONCURRENTLY against one batched final-stage peer; outputs match the
+    oracle and the engine ran fewer steps than sequential serving would."""
+    import random
+    import threading
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.ops.sampling import (
+        SamplingParams,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.batching import (
+        BatchingStageAdapter,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.client import (
+        PipelineClient,
+        make_server_record,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.executor import (
+        StageExecutor,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.transport import (
+        LocalTransport,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.scheduling.registry import (
+        PlacementRegistry,
+    )
+
+    from test_runtime_pipeline import oracle_generate
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(7), cfg)
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("4"))
+    spec = plan.stages[1]
+    inner = BatchedStageExecutor(cfg, spec,
+                                 slice_stage_params(cfg, params, spec),
+                                 slots=4, max_len=64)
+    adapter = BatchingStageAdapter(inner, window_s=0.05, peer_id="batched")
+    transport = LocalTransport()
+    transport.add_peer("batched", adapter)
+    registry = PlacementRegistry(rng=random.Random(0))
+    registry.register(make_server_record("batched", spec))
+
+    sampling = SamplingParams(temperature=0.0)
+    n_new = 6
+    prompts = [[5, 9, 23, 7, 81], [44, 2, 3], [100, 11, 12, 13]]
+    results = [None] * len(prompts)
+
+    def run(i):
+        stage0 = StageExecutor(cfg, plan.stages[0],
+                               slice_stage_params(cfg, params, plan.stages[0]),
+                               peer_id=f"client{i}")
+        client = PipelineClient(cfg, plan, stage0, transport, registry,
+                                settle_seconds=0.0, seed=0)
+        results[i] = client.generate(prompts[i], max_new_tokens=n_new,
+                                     sampling=sampling).tokens
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    # Generous deadline: cold XLA compiles under a loaded machine can take
+    # minutes; a too-short join leaves results[i] None and fails the parity
+    # assert with a misleading diff.
+    for t in threads:
+        t.join(timeout=600)
+    assert all(r is not None for r in results), "client thread(s) timed out"
+    for i, prompt in enumerate(prompts):
+        assert results[i] == oracle_generate(cfg, params, prompt, n_new,
+                                             sampling), i
+    # Coalescing happened: strictly fewer batched steps than the
+    # 3 * (n_new - 1) sequential forwards the reference would run.
+    assert inner.decode_steps < len(prompts) * (n_new - 1)
+
+
+def test_adapter_refuses_non_batchable_requests():
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.batching import (
+        BatchingStageAdapter,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.executor import (
+        StageExecutionError,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.messages import (
+        StageRequest,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(8), cfg)
+    inner = BatchedStageExecutor(cfg, full_spec(cfg), params,
+                                 slots=2, max_len=32)
+    adapter = BatchingStageAdapter(inner)
+    base = dict(session_id="s", hidden=jnp.zeros((1, 1), jnp.int32),
+                seq_len=1, cur_len=0, is_prefill=False, max_length=32)
+    for bad in (dict(hypo_ids=(0,)), dict(num_logprobs=2),
+                dict(draft_tokens=(1,)), dict(is_replay=True),
+                dict(train=True)):
+        with pytest.raises(StageExecutionError):
+            adapter.forward(StageRequest(**{**base, **bad}))
+    # decode without prefill is the per-session replay contract -> refused
+    with pytest.raises(StageExecutionError):
+        adapter.forward(StageRequest(**base))
+
+
+def test_adapter_refuses_stale_cur_len_and_round_survives():
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.batching import (
+        BatchingStageAdapter,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.executor import (
+        StageExecutionError,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.messages import (
+        StageRequest,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(9), cfg)
+    inner = BatchedStageExecutor(cfg, full_spec(cfg), params,
+                                 slots=2, max_len=32)
+    adapter = BatchingStageAdapter(inner, window_s=0.0)
+
+    def req(sid, hidden, t, cur, prefill):
+        return StageRequest(session_id=sid, hidden=hidden, seq_len=t,
+                            cur_len=cur, is_prefill=prefill, max_length=32)
+
+    adapter.forward(req("a", jnp.asarray([[5, 9, 23]], jnp.int32), 3, 0, True))
+    adapter.forward(req("b", jnp.asarray([[44, 2]], jnp.int32), 2, 0, True))
+    # A stale retry (cur_len behind the server) is REFUSED — continuing
+    # would silently desync the KV — and must not poison other sessions.
+    with pytest.raises(StageExecutionError, match="cur_len"):
+        adapter.forward(req("a", jnp.asarray([[7]], jnp.int32), 1, 1, False))
+    r = adapter.forward(req("b", jnp.asarray([[7]], jnp.int32), 1, 2, False))
+    assert r.token_id is not None
+    # ...and the correctly-positioned request for A works.
+    r = adapter.forward(req("a", jnp.asarray([[7]], jnp.int32), 1, 3, False))
+    assert r.token_id is not None
+
+
 def test_batched_stage_pipeline_matches_oracle():
     """Two batched stage executors chained as pipeline hops: batched decode
     composes with staged serving (hidden rows flow per session)."""
