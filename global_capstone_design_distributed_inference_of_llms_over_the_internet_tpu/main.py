@@ -127,6 +127,13 @@ def load_model(args) -> Tuple[ModelConfig, dict]:
 # Modes
 # ---------------------------------------------------------------------------
 
+def _model_id(args) -> str:
+    """Registry-scoping model id: --model_name, defaulting to the --model
+    preset. The ONE place the fallback rule lives — every record publish and
+    client query must agree or the swarm silently splits per model id."""
+    return args.model_name or args.model
+
+
 def run_local(args, cfg: ModelConfig, params) -> int:
     """In-process cluster: servers (fixed or LB) + client, one generation."""
     splits = parse_splits(args.splits) if args.splits else None
@@ -157,6 +164,7 @@ def run_local(args, cfg: ModelConfig, params) -> int:
                 mean_balance_check_period=args.mean_balance_check_period,
                 bandwidth_mbps=args.network_bandwidth_mbps,
                 rng=random.Random(args.seed + i),
+                model=_model_id(args),
             ).start_serving()
     else:
         for spec in plan.stages[1:]:
@@ -167,7 +175,8 @@ def run_local(args, cfg: ModelConfig, params) -> int:
                 keep_layers_resident=args.keep_layers_on_gpu,
             )
             transport.add_peer(peer, ex)
-            registry.register(make_server_record(peer, spec))
+            registry.register(make_server_record(
+                peer, spec, model=_model_id(args)))
 
     stage0 = StageExecutor(cfg, plan.stages[0], provider(plan.stages[0]),
                            peer_id="client-local")
@@ -178,6 +187,7 @@ def run_local(args, cfg: ModelConfig, params) -> int:
         total_blocks=args.total_blocks or cfg.num_layers,
         request_timeout=args.request_timeout,
         seed=args.seed,
+        model=_model_id(args),
     )
     return _generate_and_report(args, client.generate, cfg)
 
@@ -399,7 +409,8 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
     # public-maddr-only advertising, component 21 / src/main.py:492-509).
     advert = (f"{args.public_ip}:{srv.address.rsplit(':', 1)[1]}"
               if args.public_ip else srv.address)
-    rec = make_server_record(ex.peer_id, spec)
+    rec = make_server_record(ex.peer_id, spec,
+                             model=_model_id(args))
     rec.address = advert
     registry.register(rec)
     print(f"SERVING stage={args.stage} span=[{spec.start},{spec.end}) "
@@ -430,7 +441,8 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
                 rtts = (None if spec.is_last else _rtts(
                     registry, lambda r: ping_tx.ping(r.peer_id),
                     ex.peer_id, spec.end,
-                    budget_s=registry.ttl / 6.0))
+                    budget_s=registry.ttl / 6.0,
+                    model=_model_id(args)))
             except (ConnectionError, OSError) as exc:
                 logger.warning("heartbeat failed: %s", exc)
     except KeyboardInterrupt:
@@ -493,6 +505,7 @@ def _run_serve_elastic(args, cfg: ModelConfig, params) -> int:
                          "keep_layers_resident": args.keep_layers_on_gpu},
         advertise_address=advert, warmup=True,
         rng=random.Random(args.seed + os.getpid()),
+        model=_model_id(args),
     )
     es.start()
     print(f"SERVING elastic span=[{es.spec.start},{es.spec.end}) "
@@ -527,6 +540,7 @@ def run_client(args, cfg: ModelConfig, params) -> int:
         total_blocks=args.total_blocks or cfg.num_layers,
         request_timeout=args.request_timeout,
         seed=args.seed,
+        model=_model_id(args),
     )
     try:
         return _generate_and_report(args, client.generate, cfg)
@@ -550,6 +564,12 @@ def build_parser() -> argparse.ArgumentParser:
                    default="local")
     p.add_argument("--model", default="gpt2",
                    help="architecture preset (gpt2[-xl], llama-3-8b, ...)")
+    p.add_argument("--model_name", default=None,
+                   help="swarm-scoping model id for the registry (the model "
+                        "name embedded in every reference DHT key, "
+                        "src/dht_utils.py:20-31); defaults to --model. Two "
+                        "models can share one registry without cross-routing "
+                        "when every server/client passes its own name.")
     p.add_argument("--checkpoint", default=None,
                    help="local HF checkpoint dir (offline); omit for random init")
     p.add_argument("--splits", default=None,
@@ -642,7 +662,9 @@ def run_status(args) -> int:
     # ONE registry snapshot: records, coverage, and info-probe addressing all
     # derive from it, so the report describes a single swarm state (and the
     # registry sees one list RPC, not N+2).
-    records = registry.live_servers()
+    # Status shows the WHOLE swarm by default; an explicit --model_name scopes
+    # the report (and its health verdict) to that model's records.
+    records = registry.live_servers(model=args.model_name)
     if not records:
         print("no live servers")
         return 1
@@ -668,10 +690,11 @@ def run_status(args) -> int:
         rtts = ("" if not r.next_server_rtts else
                 " rtts=" + ",".join(f"{p}:{v * 1e3:.1f}ms"
                                     for p, v in r.next_server_rtts.items()))
+        mdl = f" model={r.model}" if r.model else ""
         print(f"  {r.peer_id:24s} [{r.start_block:3d},{r.end_block:3d}) "
               f"{r.state:8s} thr={r.throughput:8.2f} "
               f"cache_left={r.cache_tokens_left}"
-              f"{' FINAL' if r.final_stage else ''}{rtts}{extra}")
+              f"{' FINAL' if r.final_stage else ''}{mdl}{rtts}{extra}")
     # Coverage summary: contiguous runs of equal server-count, the exact
     # shape of the reference's log (src/dht_utils.py:227-240). The
     # CLIENT-LOCAL prefix (stage 0's span, never served remotely — the

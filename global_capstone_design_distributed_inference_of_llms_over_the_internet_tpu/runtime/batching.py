@@ -63,8 +63,6 @@ class BatchedStageExecutor:
         max_len: int = 2048,
         dtype=jnp.float32,
     ):
-        if cfg.sliding_window:
-            raise ValueError("batched serving is causal-only for now")
         self.cfg = cfg
         self.spec = spec
         self.params = params
@@ -127,6 +125,12 @@ class BatchedStageExecutor:
             causal = jnp.tril(jnp.ones((t, t), bool))
             valid = jnp.arange(t)[None, :] < t_real       # mask pad columns
             mask = causal & valid
+            if cfg.sliding_window:
+                # Mistral-style local attention: row i sees cols
+                # (i - window, i].
+                rows = jnp.arange(t)[:, None]
+                cols = jnp.arange(t)[None, :]
+                mask &= cols > rows - cfg.sliding_window
 
             def layer(h, lp):
                 from ..models.quant import dequant_tree
@@ -183,8 +187,30 @@ class BatchedStageExecutor:
             x = jnp.pad(x, pad)
         if self._prefill_jit is None:
             self._prefill_jit = self._build_prefill()
-        h, self.k, self.v = self._prefill_jit(
-            self.params, x, jnp.int32(s), self.k, self.v, jnp.int32(t))
+        try:
+            h, self.k, self.v = self._prefill_jit(
+                self.params, x, jnp.int32(s), self.k, self.v, jnp.int32(t))
+        except Exception:
+            # Failed dispatch (e.g. device OOM) must not leak the slot: the
+            # session was never established, so recycle it with a clean
+            # length instead of leaving a stale assignment until end_session.
+            self._slot_of.pop(session_id, None)
+            self.lengths[s] = 0
+            self._free.append(s)
+            # The jitted call DONATES self.k/self.v — a failure during
+            # execution (vs before dispatch) leaves them deleted, which
+            # would crash every later step with 'Array has been deleted'.
+            # Rebuild empty caches and evict all sessions: their KV is gone
+            # either way, and a refused decode is retryable (clients fail
+            # over and replay) where a poisoned engine is not.
+            if getattr(self.k, "is_deleted", lambda: False)():
+                shape = self.k.shape
+                self.k = jnp.zeros(shape, self.dtype)
+                self.v = jnp.zeros(shape, self.dtype)
+                self._slot_of.clear()
+                self.lengths[:] = 0
+                self._free = list(range(self.slots))
+            raise
         self.lengths[s] = t
         return h[:, :t]
 
@@ -217,15 +243,23 @@ class BatchedStageExecutor:
                     q = apply_rope(q, *rope)
                     k = apply_rope(k, *rope)
                 # Per-slot cache write at each slot's own length (vmap'd
-                # dynamic_update_slice; inactive slots write at their stale
-                # length and are masked out of attention AND never have
-                # their host-side length advanced, so the row is dead).
+                # dynamic_update_slice). Inactive slots write their OWN
+                # current row back: a slot parked at max_len would clamp its
+                # start to max_len-1 and clobber that session's last real KV
+                # row, so the write value for inactive slots is the row
+                # already there (one-row gather — cheaper than a full-cache
+                # select on the donated buffers).
                 upd = jax.vmap(
-                    lambda cache, new, start:
-                    jax.lax.dynamic_update_slice_in_dim(cache, new, start, 0)
+                    lambda cache, new, start, act:
+                    jax.lax.dynamic_update_slice_in_dim(
+                        cache,
+                        jnp.where(
+                            act, new,
+                            jax.lax.dynamic_slice_in_dim(cache, start, 1, 0)),
+                        start, 0)
                 )
-                k_l = upd(k_l, k.astype(k_l.dtype), lengths)
-                v_l = upd(v_l, v.astype(v_l.dtype), lengths)
+                k_l = upd(k_l, k.astype(k_l.dtype), lengths, active)
+                v_l = upd(v_l, v.astype(v_l.dtype), lengths, active)
                 # Attention over [0, length] (inclusive of the new token).
                 qg = q.reshape(S, 1, cfg.num_kv_heads, groups, cfg.head_dim)
                 scores = jnp.einsum(
@@ -233,6 +267,11 @@ class BatchedStageExecutor:
                     k_l.astype(q.dtype),
                     preferred_element_type=jnp.float32)      # [S,Hkv,G,1,M]
                 allowed = pos_grid[None, :] <= lengths[:, None]   # [S, M]
+                if cfg.sliding_window:
+                    # Query position is lengths[s]; window spans
+                    # (pos - window, pos].
+                    allowed &= (pos_grid[None, :]
+                                > lengths[:, None] - cfg.sliding_window)
                 scores = jnp.where(allowed[:, None, None, None], scores,
                                    NEG_INF)
                 probs = jax.nn.softmax(scores, axis=-1)

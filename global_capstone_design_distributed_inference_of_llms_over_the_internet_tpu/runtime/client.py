@@ -145,12 +145,25 @@ class PipelineClient:
         settle_seconds: float = SETTLE_SECONDS,
         journal_max_entries: int = 256,
         seed: int = 0,
+        model: Optional[str] = None,
     ):
         self.cfg = cfg
+        # Multi-model swarm: every discovery/coverage query is scoped to this
+        # model name (the model-prefixed DHT keys of src/dht_utils.py:20-31).
+        # None = single-model swarm, all records match.
+        self.model = model
         self.plan = plan
         self.stage0 = stage0
         self.transport = transport
         self.registry = registry
+        if route_by_latency and not use_module_routing:
+            # The latency planner only runs inside module routing
+            # (_compute_route -> _compute_module_route -> latency planner);
+            # without this, --route_by_latency alone would silently fall back
+            # to stage-index routing.
+            logger.warning("route_by_latency implies module routing; "
+                           "enabling use_module_routing")
+            use_module_routing = True
         self.use_module_routing = use_module_routing
         self.route_by_latency = route_by_latency
         self.use_push_chain = use_push_chain
@@ -195,7 +208,8 @@ class PipelineClient:
         for spec in self.plan.stages[1:]:
             key = f"stage{spec.index}"
             exclude = self.failed_peers.get(key, set())
-            peer = self.registry.discover_stage(spec.index, exclude=tuple(exclude))
+            peer = self.registry.discover_stage(spec.index, exclude=tuple(exclude),
+                                                model=self.model)
             if peer is None:
                 raise NoRouteError(f"no live server for {key}")
             hops.append(Hop(key, peer, spec.start, spec.end, spec.is_last))
@@ -237,7 +251,7 @@ class PipelineClient:
         exclude = set()
         for peers in self.failed_peers.values():
             exclude |= peers
-        records = self.registry.live_servers()
+        records = self.registry.live_servers(model=self.model)
         # Client-side pings for first-hop candidates only (the rest of the
         # route uses server-published RTTs). Pings run CONCURRENTLY and
         # recent measurements are reused — failover triggers a route refresh
@@ -274,7 +288,8 @@ class PipelineClient:
         while covered < self.total_blocks:
             key = f"blocks{covered}"
             exclude = self.failed_peers.get(key, set())
-            cands = self.registry.discover_block(covered, exclude=tuple(exclude))
+            cands = self.registry.discover_block(covered, exclude=tuple(exclude),
+                                                 model=self.model)
             # The hop must START at `covered` or earlier; its span past
             # `covered` is what advances coverage.
             cands = [c for c in cands if c.end_block > covered]
@@ -397,7 +412,8 @@ class PipelineClient:
     def _rediscover_excluding(self, hop: Hop, exclude: Tuple[str, ...]) -> Optional[str]:
         if self.use_module_routing:
             cands = [
-                c for c in self.registry.discover_block(hop.start_block, exclude=exclude)
+                c for c in self.registry.discover_block(hop.start_block, exclude=exclude,
+                                                        model=self.model)
                 # The replacement must cover the hop's exact span: downstream
                 # hops already hold KV for their own spans.
                 if c.start_block <= hop.start_block and c.end_block >= hop.end_block
@@ -407,7 +423,8 @@ class PipelineClient:
                 return None
             return max(cands, key=lambda c: (c.end_block, c.throughput)).peer_id
         stage_index = int(hop.key.removeprefix("stage"))
-        return self.registry.discover_stage(stage_index, exclude=exclude)
+        return self.registry.discover_stage(stage_index, exclude=exclude,
+                                            model=self.model)
 
     # ------------------------------------------------------------------
     # Pipeline walk
@@ -918,7 +935,8 @@ class PipelineClient:
 
 
 def make_server_record(peer_id: str, spec: StageSpec, *, throughput: float = 1.0,
-                       cache_tokens_left: Optional[int] = None) -> ServerRecord:
+                       cache_tokens_left: Optional[int] = None,
+                       model: Optional[str] = None) -> ServerRecord:
     """Registry record for a fixed-split stage server (the triple DHT publish
     of ``src/main.py:656-697`` collapsed into one record)."""
     return ServerRecord(
@@ -929,4 +947,5 @@ def make_server_record(peer_id: str, spec: StageSpec, *, throughput: float = 1.0
         final_stage=spec.is_last,
         stage_index=spec.index,
         cache_tokens_left=cache_tokens_left,
+        model=model,
     )

@@ -63,20 +63,26 @@ class StageExecutionError(RuntimeError):
     ``src/rpc_handler.py:198-202`` for decode-without-cache)."""
 
 
-def _sample_last(logits: jnp.ndarray, t_real: int, req: StageRequest) -> int:
-    """Final-stage sampling from the last REAL token's logits, using the
-    metadata-shipped params + recent window (``src/rpc_handler.py:268-307``).
-    Shared by the per-session executor and the batched adapter."""
-    last = logits[0, t_real - 1]  # [V] fp32 (lm_head upcasts)
+def _sample_rows(logits: jnp.ndarray, t_real: int, req: StageRequest) -> np.ndarray:
+    """Final-stage sampling from the last REAL token's logits, PER BATCH ROW,
+    using the metadata-shipped params + recent window
+    (``src/rpc_handler.py:268-307``). logits: [B, T, V] -> int32 [B].
+
+    Each row samples from its own logits with a row-decorrelated fold of the
+    step seed (row 0 keeps the unfolded key, so batch-1 output is bit-
+    identical to the historical single-row path). The recent-token window is
+    session-scoped metadata and therefore shared across rows — matching the
+    reference, whose generated-token window is likewise per-session
+    (``src/rpc_transport.py:788-798``)."""
+    last = logits[:, t_real - 1]  # [B, V] fp32 (lm_head upcasts)
+    b = last.shape[0]
     recent = np.zeros((RECENT_WINDOW,), np.int32)
     n = min(len(req.generated_tokens), RECENT_WINDOW)
     if n:
         recent[:n] = np.asarray(req.generated_tokens[-n:], np.int32)
     sp = req.sampling
-    rng = jax.random.PRNGKey(req.step_seed)
-    token = sample_token(
-        rng,
-        last,
+    base = jax.random.PRNGKey(req.step_seed)
+    args = (
         jnp.asarray(recent),
         jnp.asarray(n, jnp.int32),
         jnp.asarray(sp.temperature, jnp.float32),
@@ -84,7 +90,22 @@ def _sample_last(logits: jnp.ndarray, t_real: int, req: StageRequest) -> int:
         jnp.asarray(sp.top_k, jnp.int32),
         jnp.asarray(sp.repetition_penalty, jnp.float32),
     )
-    return int(token)
+    if b == 1:
+        # Hot path (every decode step in every serving mode): skip the vmap
+        # wrapper + key stack — row 0's key is the unfolded base by contract.
+        return np.asarray(sample_token(base, last[0], *args))[None]
+    rngs = jnp.stack([base if i == 0 else jax.random.fold_in(base, i)
+                      for i in range(b)])
+    tokens = jax.vmap(
+        sample_token, in_axes=(0, 0, None, None, None, None, None, None)
+    )(rngs, last, *args)
+    return np.asarray(tokens)
+
+
+def _sample_last(logits: jnp.ndarray, t_real: int, req: StageRequest) -> int:
+    """Batch-1 convenience wrapper over `_sample_rows` (the batched adapter's
+    per-slot rows are [1, T, V])."""
+    return int(_sample_rows(logits, t_real, req)[0])
 
 
 class StageExecutor:
@@ -368,9 +389,11 @@ class StageExecutor:
                     top_logprobs=tuple(tuple(float(v) for v in row)
                                        for row in np.asarray(vals)),
                 )
-            token = self._sample(out, out.shape[1], req)
+            row_tokens = _sample_rows(out, out.shape[1], req)
             return StageResponse(
-                session_id=req.session_id, token_id=int(token),
+                session_id=req.session_id, token_id=int(row_tokens[0]),
+                token_ids=(tuple(int(t) for t in row_tokens)
+                           if row_tokens.shape[0] > 1 else None),
                 cache_len=handle.cache_len,
             )
         out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
@@ -466,9 +489,6 @@ class StageExecutor:
             n_accepted=n_acc,
             cache_len=handle.cache_len,
         )
-
-    def _sample(self, logits: jnp.ndarray, t_real: int, req: StageRequest) -> int:
-        return _sample_last(logits, t_real, req)
 
     # ------------------------------------------------------------------
     # Fine-tuning path (vendored rpc_forward/rpc_backward training surface,

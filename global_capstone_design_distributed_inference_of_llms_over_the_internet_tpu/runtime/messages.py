@@ -115,6 +115,9 @@ class StageResponse:
     session_id: str
     hidden: Optional[jnp.ndarray] = None   # [B, T, D]
     token_id: Optional[int] = None
+    # Batch>1 plain sampling: one token per batch row (token_id mirrors row 0
+    # for back-compat). None for batch-1 responses.
+    token_ids: Optional[Tuple[int, ...]] = None
     cache_len: int = 0                     # server-side KV length after the step
     # Beam mode (request.num_logprobs > 0): per batch row, the top-N
     # continuation candidates from the final stage's logits.

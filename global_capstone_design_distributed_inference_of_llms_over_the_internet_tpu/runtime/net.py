@@ -465,10 +465,13 @@ class TcpStageServer(_FramedTcpServer):
                                               f"{self.compute_timeout:.0f}s"})
                 return
             if resp.is_token:
-                _send_frame(sock, {
+                frame = {
                     "verb": "token", "session_id": resp.session_id,
                     "token_id": resp.token_id, "cache_len": resp.cache_len,
-                })
+                }
+                if resp.token_ids is not None:   # batch>1 per-row sampling
+                    frame["token_ids"] = list(resp.token_ids)
+                _send_frame(sock, frame)
             elif resp.is_speculative:
                 _send_frame(sock, {
                     "verb": "spec", "session_id": resp.session_id,
@@ -736,9 +739,12 @@ class TcpTransport(Transport):
                 cache_len=header["cache_len"],
             )
         if verb == "token":
+            ids = header.get("token_ids")
             return StageResponse(
                 session_id=header["session_id"],
-                token_id=header["token_id"], cache_len=header["cache_len"],
+                token_id=header["token_id"],
+                token_ids=None if ids is None else tuple(ids),
+                cache_len=header["cache_len"],
             )
         if verb == "beam":
             return StageResponse(
@@ -885,7 +891,7 @@ def check_direct_reachability(transport: TcpTransport, registry,
 
 _REC_FIELDS = ("peer_id", "start_block", "end_block", "throughput", "state",
                "final_stage", "stage_index", "cache_tokens_left", "address",
-               "next_server_rtts")
+               "next_server_rtts", "model")
 
 
 def _rec_to_dict(rec: ServerRecord) -> dict:
@@ -1020,22 +1026,22 @@ class RemoteRegistry:
             rec.timestamp = now - float(d.get("age_s") or 0.0)
         self._local = fresh
 
-    def live_servers(self):
+    def live_servers(self, model=None):
         self._refresh()
-        return self._local.live_servers()
+        return self._local.live_servers(model=model)
 
     def get(self, peer_id: str):
         self._refresh()
         return self._local.get(peer_id)
 
-    def discover_stage(self, stage_index: int, exclude=()):
+    def discover_stage(self, stage_index: int, exclude=(), model=None):
         self._refresh()
-        return self._local.discover_stage(stage_index, exclude)
+        return self._local.discover_stage(stage_index, exclude, model=model)
 
-    def discover_block(self, block: int, exclude=()):
+    def discover_block(self, block: int, exclude=(), model=None):
         self._refresh()
-        return self._local.discover_block(block, exclude)
+        return self._local.discover_block(block, exclude, model=model)
 
-    def coverage(self, total_blocks: int):
+    def coverage(self, total_blocks: int, model=None):
         self._refresh()
-        return self._local.coverage(total_blocks)
+        return self._local.coverage(total_blocks, model=model)

@@ -60,6 +60,7 @@ def measure_next_server_rtts(
     end_block: int,
     max_peers: int = MAX_PINGED_NEXT_SERVERS,
     budget_s: Optional[float] = None,
+    model: Optional[str] = None,
 ) -> Dict[str, float]:
     """Ping the live servers able to serve ``end_block`` (this server's likely
     next hops) and return {peer_id: rtt_seconds}. Unreachable peers are
@@ -69,7 +70,7 @@ def measure_next_server_rtts(
     inside heartbeat loops, and a pile-up of timing-out pings must not
     stretch the inter-refresh gap past the registry TTL."""
     cands = [
-        r for r in registry.live_servers()
+        r for r in registry.live_servers(model=model)
         if r.peer_id != peer_id
         and r.start_block <= end_block < r.end_block
     ]
@@ -128,8 +129,12 @@ class ElasticStageServer:
         advertise_address: Optional[str] = None,
         warmup: bool = False,
         pinger: Optional[Callable[[ServerRecord], Optional[float]]] = None,
+        model: Optional[str] = None,
     ):
         self.peer_id = peer_id
+        # Model name scoping every record this server publishes and every
+        # swarm query it makes (multi-model registry — src/dht_utils.py:20-31).
+        self.model = model
         self.cfg = cfg
         self.params_provider = params_provider
         self.registry = registry
@@ -179,7 +184,7 @@ class ElasticStageServer:
 
     def choose_span(self) -> StageSpec:
         """Rule 1 over the current live swarm (excluding self)."""
-        records = [r for r in self.registry.live_servers()
+        records = [r for r in self.registry.live_servers(model=self.model)
                    if r.peer_id != self.peer_id]
         blocks = lb.choose_best_blocks(
             self.num_blocks, records, total_blocks=self.total_blocks,
@@ -197,7 +202,7 @@ class ElasticStageServer:
         self.registry.register(ServerRecord(
             peer_id=self.peer_id, start_block=spec.start, end_block=spec.end,
             throughput=self.throughput, state=ServerState.JOINING,
-            final_stage=spec.is_last,
+            final_stage=spec.is_last, model=self.model,
         ))
         params = self.params_provider(spec)
         self.executor = StageExecutor(self.cfg, spec, params,
@@ -227,6 +232,7 @@ class ElasticStageServer:
             ),
             address=self.advertise_address,
             next_server_rtts=self._published_rtts(),
+            model=self.model,
         )
 
     def _probe(self) -> float:
@@ -307,7 +313,7 @@ class ElasticStageServer:
         else:
             self.next_server_rtts = measure_next_server_rtts(
                 self.registry, self._pinger, self.peer_id, self.spec.end,
-                budget_s=self.registry.ttl / 6.0)
+                budget_s=self.registry.ttl / 6.0, model=self.model)
         return self.next_server_rtts
 
     def maybe_rebalance(self) -> bool:
@@ -315,7 +321,7 @@ class ElasticStageServer:
         Returns whether a re-span happened."""
         if self.spec is None:
             return False
-        records = self.registry.live_servers()
+        records = self.registry.live_servers(model=self.model)
         if not lb.should_choose_other_blocks(
             self.peer_id, records, total_blocks=self.total_blocks,
             balance_quality=self.balance_quality, min_block=self.min_block,
@@ -407,8 +413,10 @@ class FixedStageServer:
         executor_kwargs: Optional[dict] = None,
         total_blocks: Optional[int] = None,
         pinger: Optional[Callable[[ServerRecord], Optional[float]]] = None,
+        model: Optional[str] = None,
     ):
         self.peer_id = peer_id
+        self.model = model
         self.spec = spec
         self.registry = registry
         self.transport = transport
@@ -427,6 +435,7 @@ class FixedStageServer:
             state=ServerState.ONLINE, final_stage=self.spec.is_last,
             stage_index=self.spec.index,
             next_server_rtts=self._published_rtts(),
+            model=self.model,
         )
 
     def start_serving(self) -> None:
@@ -448,7 +457,7 @@ class FixedStageServer:
         else:
             self.next_server_rtts = measure_next_server_rtts(
                 self.registry, self._pinger, self.peer_id, self.spec.end,
-                budget_s=self.registry.ttl / 6.0)
+                budget_s=self.registry.ttl / 6.0, model=self.model)
         return self.next_server_rtts
 
     def heartbeat_once(self) -> None:

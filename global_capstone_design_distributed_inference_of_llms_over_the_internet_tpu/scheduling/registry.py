@@ -54,6 +54,12 @@ class ServerRecord:
     throughput: float = 1.0
     state: str = ServerState.ONLINE
     final_stage: bool = False
+    # Which model this server's span belongs to. Every reference DHT key
+    # embeds the model name (``src/dht_utils.py:20-31``,
+    # ``petals/server/server.py:738-744``) so multiple models can share one
+    # control plane; records with different models never cross-route. None =
+    # single-model swarm (matches any query — the pre-multi-model schema).
+    model: Optional[str] = None
     stage_index: Optional[int] = None      # fixed-split mode stage number
     cache_tokens_left: Optional[int] = None  # petals/server/server.py:721
     address: Optional[str] = None          # "host:port" for the TCP data plane
@@ -66,6 +72,15 @@ class ServerRecord:
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (now or time.monotonic()) >= self.expires_at
+
+
+def _model_ok(rec: ServerRecord, model: Optional[str]) -> bool:
+    """Model filter for discovery/coverage queries: a query for model M sees
+    M's records plus legacy untagged ones; a query with no model sees all
+    (single-model swarm). Mirrors the reference's model-prefixed DHT keys
+    (``src/dht_utils.py:20-31``) — two models on one registry must never
+    cross-route."""
+    return model is None or rec.model is None or rec.model == model
 
 
 class PlacementRegistry:
@@ -121,17 +136,19 @@ class PlacementRegistry:
 
     # -- queries ------------------------------------------------------------
 
-    def _live(self, now: Optional[float] = None) -> List[ServerRecord]:
+    def _live(self, now: Optional[float] = None,
+              model: Optional[str] = None) -> List[ServerRecord]:
         now = now or time.monotonic()
         with self._lock:
             # Purge expired entries on read (the DHT does this implicitly).
             dead = [p for p, r in self._servers.items() if r.expired(now)]
             for p in dead:
                 del self._servers[p]
-            return list(self._servers.values())
+            return [r for r in self._servers.values()
+                    if _model_ok(r, model)]
 
-    def live_servers(self) -> List[ServerRecord]:
-        return self._live()
+    def live_servers(self, model: Optional[str] = None) -> List[ServerRecord]:
+        return self._live(model=model)
 
     def get(self, peer_id: str) -> Optional[ServerRecord]:
         with self._lock:
@@ -142,21 +159,23 @@ class PlacementRegistry:
             return rec
 
     def discover_stage(self, stage_index: int,
-                       exclude: Sequence[str] = ()) -> Optional[str]:
+                       exclude: Sequence[str] = (),
+                       model: Optional[str] = None) -> Optional[str]:
         """Pick a server for a fixed-split stage: random among the 5 newest
         live candidates, excluding known-failed peers
         (``src/rpc_transport.py:270-353``)."""
         cands = [
-            r for r in self._live()
+            r for r in self._live(model=model)
             if r.stage_index == stage_index and r.peer_id not in exclude
             and r.state == ServerState.ONLINE
         ]
         return self._pick_newest(cands)
 
-    def discover_block(self, block: int, exclude: Sequence[str] = ()) -> List[ServerRecord]:
+    def discover_block(self, block: int, exclude: Sequence[str] = (),
+                       model: Optional[str] = None) -> List[ServerRecord]:
         """All live ONLINE servers covering `block` (module-routing mode)."""
         return [
-            r for r in self._live()
+            r for r in self._live(model=model)
             if r.start_block <= block < r.end_block and r.peer_id not in exclude
             and r.state == ServerState.ONLINE
         ]
@@ -168,10 +187,11 @@ class PlacementRegistry:
         pool = cands[:DISCOVERY_POOL]
         return self._rng.choice(pool).peer_id
 
-    def coverage(self, total_blocks: int) -> List[List[ServerRecord]]:
+    def coverage(self, total_blocks: int,
+                 model: Optional[str] = None) -> List[List[ServerRecord]]:
         """Per-block server lists — the shape of ``get_remote_module_infos``
         (``src/dht_utils.py:147-242``); feeds load balancing."""
-        live = self._live()
+        live = self._live(model=model)
         return [
             [r for r in live if r.start_block <= b < r.end_block]
             for b in range(total_blocks)

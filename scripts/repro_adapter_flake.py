@@ -1,0 +1,140 @@
+"""Instrumented repro for the batched-adapter concurrency flake.
+
+Runs the 3-threaded-clients-vs-one-batched-peer scenario in a loop with a
+per-request event log; on first token divergence vs the oracle, dumps the
+trace for the offending session. Diagnostic tool, not a test.
+"""
+
+import random
+import sys
+import threading
+import time
+
+import os
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tests"))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+    init_params,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.partition import (
+    StagePlan,
+    parse_splits,
+    slice_stage_params,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.ops.sampling import (
+    SamplingParams,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.batching import (
+    BatchedStageExecutor,
+    BatchingStageAdapter,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.client import (
+    PipelineClient,
+    make_server_record,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.executor import (
+    StageExecutor,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.transport import (
+    LocalTransport,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.scheduling.registry import (
+    PlacementRegistry,
+)
+
+from test_runtime_pipeline import oracle_generate, tiny_cfg
+
+EVENTS = []
+EV_LOCK = threading.Lock()
+
+
+def log_event(*a):
+    with EV_LOCK:
+        EVENTS.append((time.monotonic(), *a))
+
+
+class LoggingAdapter(BatchingStageAdapter):
+    def forward(self, req):
+        kind = "prefill" if req.is_prefill else "decode"
+        try:
+            resp = super().forward(req)
+        except Exception as exc:
+            log_event(kind, req.session_id, req.cur_len, "ERR", str(exc)[:80])
+            raise
+        log_event(kind, req.session_id, req.cur_len,
+                  "tok", resp.token_id, "cache_len", resp.cache_len)
+        return resp
+
+
+def run_once(trial):
+    EVENTS.clear()
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(7), cfg)
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("4"))
+    spec = plan.stages[1]
+    inner = BatchedStageExecutor(cfg, spec,
+                                 slice_stage_params(cfg, params, spec),
+                                 slots=4, max_len=64)
+    adapter = LoggingAdapter(inner, window_s=0.05, peer_id="batched")
+    transport = LocalTransport()
+    transport.add_peer("batched", adapter)
+    registry = PlacementRegistry(rng=random.Random(0))
+    registry.register(make_server_record("batched", spec))
+
+    sampling = SamplingParams(temperature=0.0)
+    n_new = 6
+    prompts = [[5, 9, 23, 7, 81], [44, 2, 3], [100, 11, 12, 13]]
+    results = [None] * len(prompts)
+    errors = [None] * len(prompts)
+
+    def run(i):
+        try:
+            stage0 = StageExecutor(cfg, plan.stages[0],
+                                   slice_stage_params(cfg, params,
+                                                      plan.stages[0]),
+                                   peer_id=f"client{i}")
+            client = PipelineClient(cfg, plan, stage0, transport, registry,
+                                    settle_seconds=0.0, seed=0)
+            results[i] = client.generate(prompts[i], max_new_tokens=n_new,
+                                         sampling=sampling).tokens
+        except Exception as exc:
+            errors[i] = exc
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+
+    bad = False
+    for i, prompt in enumerate(prompts):
+        want = oracle_generate(cfg, params, prompt, n_new, sampling)
+        if results[i] != want:
+            bad = True
+            print(f"trial {trial}: client {i} DIVERGED")
+            print("  got ", results[i], "err:", errors[i])
+            print("  want", want)
+    if bad:
+        print("---- event trace ----")
+        t0 = EVENTS[0][0] if EVENTS else 0
+        for ev in EVENTS:
+            print(f"  {ev[0]-t0:8.4f} {ev[1:]}")
+    return not bad
+
+
+if __name__ == "__main__":
+    for trial in range(int(sys.argv[1]) if len(sys.argv) > 1 else 10):
+        ok = run_once(trial)
+        print(f"trial {trial}: {'ok' if ok else 'FAILED'}")
+        if not ok:
+            sys.exit(1)

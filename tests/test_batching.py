@@ -234,9 +234,62 @@ def test_adapter_serves_concurrent_clients_through_transport():
     for i, prompt in enumerate(prompts):
         assert results[i] == oracle_generate(cfg, params, prompt, n_new,
                                              sampling), i
-    # Coalescing happened: strictly fewer batched steps than the
-    # 3 * (n_new - 1) sequential forwards the reference would run.
-    assert inner.decode_steps < len(prompts) * (n_new - 1)
+    # Coalescing is asserted deterministically (barrier-synchronized) in
+    # test_adapter_coalesces_concurrent_decodes — under heavy CPU contention
+    # these free-running clients can legitimately serialize, so a step-count
+    # bound here would be a load-dependent flake.
+    assert inner.decode_steps <= len(prompts) * (n_new - 1)
+
+
+def test_adapter_coalesces_concurrent_decodes():
+    """Deterministic coalescing check: N decode requests enter the adapter
+    together (barrier just before forward), so the leader's window must
+    merge them into ONE batched step."""
+    import threading
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.batching import (
+        BatchingStageAdapter,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.messages import (
+        StageRequest,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(21), cfg)
+    inner = BatchedStageExecutor(cfg, full_spec(cfg), params,
+                                 slots=4, max_len=32)
+    adapter = BatchingStageAdapter(inner, window_s=1.0, peer_id="batched")
+    prompts = {"a": [5, 9, 23], "b": [44, 2], "c": [100, 11, 12]}
+    for sid, p in prompts.items():
+        adapter.forward(StageRequest(
+            session_id=sid, hidden=jnp.asarray([p], jnp.int32),
+            seq_len=len(p), cur_len=0, is_prefill=True, max_length=32))
+    # Warm the decode compile OUTSIDE the timed window so the barrier'd
+    # round's wall time is pure window, not a 40s first compile.
+    inner.decode_batch({"a": jnp.asarray([[7]], jnp.int32)})
+    inner.lengths[inner.slot("a")] -= 1  # undo the warm step's advance
+
+    barrier = threading.Barrier(len(prompts))
+    tokens = {}
+
+    def run(sid, p):
+        barrier.wait()
+        r = adapter.forward(StageRequest(
+            session_id=sid, hidden=jnp.asarray([[7]], jnp.int32),
+            seq_len=1, cur_len=len(p), is_prefill=False, max_length=32))
+        tokens[sid] = r.token_id
+
+    before = inner.decode_steps
+    threads = [threading.Thread(target=run, args=(sid, p))
+               for sid, p in prompts.items()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert set(tokens) == set(prompts)
+    # All three sessions advanced in ONE batched step (the 1s window gives
+    # even a loaded machine time to admit barrier-released followers).
+    assert inner.decode_steps == before + 1
 
 
 def test_adapter_refuses_non_batchable_requests():
@@ -299,6 +352,48 @@ def test_adapter_refuses_stale_cur_len_and_round_survives():
     # ...and the correctly-positioned request for A works.
     r = adapter.forward(req("a", jnp.asarray([[7]], jnp.int32), 1, 3, False))
     assert r.token_id is not None
+
+
+def test_batched_mistral_sliding_window_matches_oracle():
+    """Sliding-window (Mistral) attention on the batched path: windowed
+    masks in prefill and decode match the per-session oracle, with prompts
+    long enough that the window actually truncates attention."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+        mistral_config,
+    )
+
+    cfg = mistral_config(
+        sliding_window=4, vocab_size=257, hidden_size=64, num_layers=4,
+        num_heads=4, num_kv_heads=2, intermediate_size=128,
+        max_position_embeddings=256)
+    assert cfg.sliding_window == 4
+    params = init_params(jax.random.PRNGKey(11), cfg)
+    ex = BatchedStageExecutor(cfg, full_spec(cfg), params,
+                              slots=4, max_len=64)
+    n_new = 6   # prompts up to 7 tokens + 6 generated >> window of 4
+    got = batched_generate(ex, PROMPTS, n_new)
+    for sid, prompt in PROMPTS.items():
+        assert got[sid] == oracle_tokens(cfg, params, prompt, n_new), sid
+
+
+def test_prefill_failure_frees_slot():
+    """A prefill whose jitted dispatch raises must recycle the slot instead
+    of leaking it until end_session (advisor finding)."""
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(12), cfg)
+    ex = BatchedStageExecutor(cfg, full_spec(cfg), params,
+                              slots=1, max_len=32)
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic dispatch failure")
+
+    ex._prefill_jit = boom
+    with pytest.raises(RuntimeError, match="synthetic"):
+        ex.prefill("s1", np.asarray([[1, 2, 3]], np.int32))
+    assert ex.slot("s1") is None
+    ex._prefill_jit = None          # rebuild the real jit
+    ex.prefill("s2", np.asarray([[4, 5]], np.int32))   # slot is usable again
+    assert ex.slot("s2") is not None
 
 
 def test_batched_stage_pipeline_matches_oracle():
