@@ -430,7 +430,14 @@ class BatchingStageAdapter:
         with self._lock:  # slot tables + cache arrays are shared state
             try:
                 h = self.inner.prefill(req.session_id, req.hidden)
-            except (SlotFull, ValueError) as exc:
+            except StageExecutionError:
+                raise
+            except Exception as exc:
+                # Same taxonomy as decode's whole-round failures: the engine
+                # recovered its slot/caches specifically so the request is
+                # retryable — a raw XlaRuntimeError would cross the wire as a
+                # kind-less error outside the client's failover taxonomy and
+                # crash the generation instead of re-routing it.
                 raise StageExecutionError(str(exc)) from exc
             cache_len = int(self.inner.lengths[self.inner.slot(req.session_id)])
         return self._respond(req, h, cache_len)

@@ -41,3 +41,34 @@ jax.config.update("jax_enable_compilation_cache", False)
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)
+
+
+# ---------------------------------------------------------------------------
+# Smoke tier: `pytest -m smoke` runs a <5-min correctness core (oracle
+# parity, one TCP failover, one elastic re-span, KV arena + LB math) for
+# fast iteration; the full ~35-min suite stays the default.
+# ---------------------------------------------------------------------------
+
+_SMOKE = (
+    # whole fast modules (pure-Python or tiny-jit)
+    "test_kv_cache.py",
+    "test_load_balancing.py",
+    "test_partition.py",
+    "test_task_pool.py",
+    "test_throughput.py",
+    # curated representatives of the heavier engines
+    "test_runtime_pipeline.py::test_pipeline_greedy_matches_oracle",
+    "test_runtime_pipeline.py::test_failover_mid_generation_preserves_tokens",
+    "test_net.py::test_tensor_codec_roundtrip",
+    "test_net.py::test_registry_service_ttl_and_discovery",
+    "test_elastic_server.py::test_rebalance_respans_stacked_servers",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        rel = item.nodeid.replace("\\", "/").split("tests/")[-1]
+        mod = rel.split("::")[0]
+        if mod in _SMOKE or any(rel.startswith(s) for s in _SMOKE
+                                if "::" in s):
+            item.add_marker(pytest.mark.smoke)
