@@ -489,7 +489,20 @@ def _run_serve_elastic(args, cfg: ModelConfig, params) -> int:
     splits = parse_splits(args.splits) if args.splits else None
     min_block = splits[0] if splits else 0  # client-local prefix floor
     total = args.total_blocks or cfg.num_layers
-    num_blocks = args.num_blocks or max(1, (total - min_block) // 3)
+    num_blocks = args.num_blocks
+    if num_blocks is None:
+        # No --num_blocks: derive capacity from the REAL device memory
+        # (weights + KV arena + headroom, petals server.py:275-326), falling
+        # back to the even-thirds topology heuristic when the backend
+        # publishes no byte limit (host CPU).
+        from .runtime.server import derive_num_blocks
+
+        num_blocks = derive_num_blocks(
+            cfg, dtype_bytes=jnp.dtype(_DTYPE_MAP[args.dtype]).itemsize,
+            quant=args.quant)
+        if num_blocks is not None:
+            num_blocks = min(num_blocks, max(total - min_block, 1))
+    num_blocks = num_blocks or max(1, (total - min_block) // 3)
     from .runtime.net import TcpTransport as _TT
 
     ping_tx = _TT(registry, wire_dtype=args.wire_dtype)
@@ -580,9 +593,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "run all stages in-process and ignore it.")
     p.add_argument("--dtype", choices=["float32", "bfloat16", "float16"],
                    default="float32")
-    p.add_argument("--quant", choices=["none", "int8"], default="none",
+    p.add_argument("--quant", choices=["none", "int8", "nf4"], default="none",
                    help="weight-only block quantization on stage servers "
-                        "(reference V9 surface; int8 per-channel)")
+                        "(reference V9 surface: int8 per-channel, nf4 "
+                        "4-bit NormalFloat at 4.25 bits/param)")
     p.add_argument("--prompt", default="Hello, my name is")
     p.add_argument("--max_new_tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.7)

@@ -86,6 +86,83 @@ def measure_next_server_rtts(
     return rtts
 
 
+def _tpu_hbm_bytes(device_kind: str) -> Optional[int]:
+    """HBM capacity per chip by TPU generation (public specs), for runtimes
+    that expose no allocator stats. None for unknown kinds."""
+    kind = device_kind.lower()
+    table = (
+        ("v5 lite", 16), ("v5e", 16),
+        ("v5p", 95), ("v5", 95),          # bare "v5" after lite/e checked
+        ("v6 lite", 32), ("v6e", 32), ("trillium", 32),
+        ("v4 lite", 8), ("v4", 32),
+        ("v3", 16), ("v2", 8),
+    )
+    for key, gib in table:
+        if key in kind:
+            return gib << 30
+    return None
+
+
+def derive_num_blocks(
+    cfg: ModelConfig,
+    *,
+    dtype_bytes: int = 2,
+    quant: str = "none",
+    attn_cache_bytes: int = 1 << 30,
+    device=None,
+    headroom_fraction: float = 0.15,
+) -> Optional[int]:
+    """Server auto-capacity: how many blocks fit THIS device's free memory
+    after the KV arena and an activation-headroom reserve — the reference's
+    ``_choose_num_blocks`` (``petals/server/server.py:275-326``), which
+    budgets weights + attention cache + headroom out of free GPU memory when
+    ``--num_blocks`` is omitted.
+
+    Reads ``device.memory_stats()`` (real HBM numbers on TPU). Returns None
+    when the backend publishes no byte limit (e.g. host CPU) — the caller
+    falls back to its topology heuristic, mirroring the reference's behavior
+    on devices it cannot introspect."""
+    import jax
+
+    from ..models.quant import choose_num_blocks
+
+    device = device or jax.devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)() or {}
+    limit = stats.get("bytes_limit")
+    if not limit and getattr(device, "platform", None) == "tpu":
+        # Some TPU runtimes (e.g. tunneled plugins) publish no allocator
+        # stats; fall back to the device generation's known HBM size so a
+        # flagless server still sizes itself on real hardware.
+        limit = _tpu_hbm_bytes(getattr(device, "device_kind", ""))
+    if not limit:
+        return None
+    free = max(0, int(limit) - int(stats.get("bytes_in_use", 0) or 0))
+    from ..models.quant import block_bytes
+
+    usable = int(free * (1.0 - headroom_fraction)) - attn_cache_bytes
+    per = block_bytes(cfg, dtype_bytes, quant)
+    if usable < per:
+        # The reference raises when even one block does not fit
+        # (server.py:275-326); choose_num_blocks floors at 1, which here
+        # would log a "budget-checked" count and then OOM at startup.
+        raise RuntimeError(
+            f"device memory cannot fit one {quant or 'full'}-precision "
+            f"block: free={free / 2**30:.2f} GiB, KV arena="
+            f"{attn_cache_bytes / 2**30:.2f} GiB, block="
+            f"{per / 2**30:.2f} GiB (pass --num_blocks to override, or "
+            "shrink the arena / use --quant)")
+    n = choose_num_blocks(
+        cfg, free, dtype_bytes=dtype_bytes, quant=quant,
+        attn_cache_bytes=attn_cache_bytes,
+        reserve_fraction=headroom_fraction,
+    )
+    logger.info(
+        "auto num_blocks=%d (free=%.2f GiB of %.2f GiB, arena=%.2f GiB, "
+        "quant=%s, %.0f%% headroom)", n, free / 2**30, int(limit) / 2**30,
+        attn_cache_bytes / 2**30, quant, headroom_fraction * 100)
+    return n
+
+
 def _pinger_from_transport(
     transport,
 ) -> Optional[Callable[[ServerRecord], Optional[float]]]:

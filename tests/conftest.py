@@ -44,7 +44,7 @@ def rng():
 
 
 # ---------------------------------------------------------------------------
-# Smoke tier: `pytest -m smoke` runs a <5-min correctness core (oracle
+# Smoke tier: `pytest -m smoke` runs a <2-min correctness core (oracle
 # parity, one TCP failover, one elastic re-span, KV arena + LB math) for
 # fast iteration; the full ~35-min suite stays the default.
 # ---------------------------------------------------------------------------

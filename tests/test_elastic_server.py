@@ -215,3 +215,85 @@ def test_heartbeat_self_heals_after_expiry():
     assert registry.live_servers() == []
     s.heartbeat_once()
     assert [r.peer_id for r in registry.live_servers()] == ["srv-a"]
+
+
+# ---------------------------------------------------------------------------
+# Auto capacity sizing (petals/server/server.py:275-326 _choose_num_blocks)
+# ---------------------------------------------------------------------------
+
+class _FakeDevice:
+    def __init__(self, limit, in_use=0):
+        self._stats = ({"bytes_limit": limit, "bytes_in_use": in_use}
+                       if limit is not None else None)
+
+    def memory_stats(self):
+        return self._stats
+
+
+def test_derive_num_blocks_matches_arena_accounting():
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.quant import (
+        block_bytes,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.server import (
+        derive_num_blocks,
+    )
+
+    cfg = tiny_cfg()
+    per = block_bytes(cfg, dtype_bytes=2)
+    arena = 1 << 20
+    # Budget sized for exactly 3 blocks AFTER the arena + 15% headroom:
+    # usable = limit * 0.85 - arena  =>  limit = (3*per + arena) / 0.85 + eps
+    limit = int((3 * per + arena) / 0.85) + 16
+    n = derive_num_blocks(cfg, dtype_bytes=2, attn_cache_bytes=arena,
+                          device=_FakeDevice(limit))
+    assert n == 3
+    # bytes_in_use shrinks the budget
+    n2 = derive_num_blocks(cfg, dtype_bytes=2, attn_cache_bytes=arena,
+                           device=_FakeDevice(limit, in_use=2 * per))
+    assert n2 < 3
+    # quant packs more blocks into the same budget
+    n4 = derive_num_blocks(cfg, dtype_bytes=2, attn_cache_bytes=arena,
+                           quant="nf4", device=_FakeDevice(limit))
+    assert n4 > n
+    # no byte limit (host CPU): None -> caller falls back to its heuristic
+    assert derive_num_blocks(cfg, device=_FakeDevice(None)) is None
+
+
+def test_elastic_server_with_derived_capacity_serves():
+    """End-to-end: a server whose num_blocks came from derive_num_blocks
+    joins the swarm and serves its span."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.quant import (
+        block_bytes,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.server import (
+        derive_num_blocks,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    transport, registry, provider = make_swarm(cfg, params)
+    per = block_bytes(cfg, dtype_bytes=4)
+    arena = 1 << 20
+    limit = int((4 * per + arena) / 0.85) + 16
+    n = derive_num_blocks(cfg, dtype_bytes=4, attn_cache_bytes=arena,
+                          device=_FakeDevice(limit))
+    assert n == 4
+    es = make_elastic("auto", cfg, provider, registry, transport, n)
+    es.start_serving()
+    assert es.spec.num_layers == min(n, cfg.num_layers - MIN_BLOCK)
+    rec = registry.get("auto")
+    assert rec is not None and rec.end_block - rec.start_block == es.spec.num_layers
+    es.shutdown()
+
+
+def test_derive_num_blocks_raises_when_nothing_fits():
+    import pytest as _pytest
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.server import (
+        derive_num_blocks,
+    )
+
+    cfg = tiny_cfg()
+    with _pytest.raises(RuntimeError, match="cannot fit one"):
+        derive_num_blocks(cfg, dtype_bytes=2, attn_cache_bytes=1 << 30,
+                          device=_FakeDevice(1 << 30))  # free < arena
