@@ -56,6 +56,12 @@ logger = logging.getLogger(__name__)
 
 MAGIC = b"MPT1"
 MAX_FRAME = 1 << 30
+# Payloads beyond this are STREAMED as per-chunk-CRC'd segments (the
+# reference splits at DEFAULT_MAX_MSG_SIZE, src/rpc_transport.py:551-562):
+# progressive transfer with bounded sender memory (no giant concat copy),
+# early corruption detection, and no hard 1 GiB payload ceiling.
+CHUNK_SIZE = 64 * 1024 * 1024
+MAX_PAYLOAD = 8 << 30          # 8 GiB sanity cap on a chunked payload
 
 
 class WireError(ConnectionError):
@@ -67,6 +73,24 @@ class WireError(ConnectionError):
 # ---------------------------------------------------------------------------
 
 def _send_frame(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
+    if len(payload) > CHUNK_SIZE:
+        # Chunked transfer: the base frame carries an empty payload and a
+        # "chunked" descriptor; the chunks follow as [len | bytes | crc32c]
+        # segments. Each chunk is integrity-checked independently, so a
+        # corrupt segment of a multi-GB activation is caught after one
+        # chunk, not after the whole transfer.
+        header = dict(header,
+                      chunked={"total": len(payload), "chunk": CHUNK_SIZE})
+        hdr = json.dumps(header).encode()
+        sock.sendall(MAGIC + struct.pack("<I", len(hdr)) + hdr
+                     + struct.pack("<I", 0) + struct.pack("<I", native.crc32c(b"")))
+        mv = memoryview(payload)
+        for off in range(0, len(payload), CHUNK_SIZE):
+            chunk = bytes(mv[off:off + CHUNK_SIZE])
+            sock.sendall(struct.pack("<I", len(chunk)))
+            sock.sendall(chunk)
+            sock.sendall(struct.pack("<I", native.crc32c(chunk)))
+        return
     hdr = json.dumps(header).encode()
     crc = native.crc32c(payload)
     sock.sendall(
@@ -107,6 +131,27 @@ def _recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
     (crc,) = struct.unpack("<I", _recv_exact(sock, 4))
     if crc != native.crc32c(payload):
         raise WireError("payload checksum mismatch")
+    ch = header.get("chunked")
+    if ch:
+        total = int(ch["total"])
+        if not 0 <= total <= MAX_PAYLOAD:
+            raise WireError(f"oversized chunked payload {total}")
+        # Grow the buffer as data ARRIVES — preallocating the header-declared
+        # total would let a hostile 100-byte frame force a MAX_PAYLOAD-sized
+        # allocation before committing a single chunk byte (remote OOM).
+        buf = bytearray()
+        off = 0
+        while off < total:
+            (clen,) = struct.unpack("<I", _recv_exact(sock, 4))
+            if clen == 0 or clen > MAX_FRAME or off + clen > total:
+                raise WireError(f"bad chunk length {clen} at offset {off}")
+            chunk = _recv_exact(sock, clen)
+            (ccrc,) = struct.unpack("<I", _recv_exact(sock, 4))
+            if ccrc != native.crc32c(chunk):
+                raise WireError(f"chunk checksum mismatch at offset {off}")
+            buf += chunk
+            off += clen
+        payload = bytes(buf)
     return header, payload
 
 
@@ -258,6 +303,7 @@ class _FramedTcpServer:
             def shutdown_request(self, request):
                 with active_lock:
                     active.discard(request)
+                outer._on_connection_closed(request)
                 super().shutdown_request(request)
 
         self._server = Server((host, port), Handler)
@@ -285,6 +331,9 @@ class _FramedTcpServer:
 
     def _dispatch(self, sock, header: dict, payload: bytes) -> None:
         raise NotImplementedError
+
+    def _on_connection_closed(self, sock) -> None:
+        """Hook: a connection's handler finished (socket about to close)."""
 
 
 # ---------------------------------------------------------------------------
@@ -323,17 +372,27 @@ class TcpStageServer(_FramedTcpServer):
         # addr -> (socket, per-connection send/recv lock)
         self._relay_conns: Dict[str, tuple] = {}
         self._relay_lock = threading.Lock()
+        # Persistent inference streams (petals handler.py:132-308): per
+        # CONNECTION, session_id -> stream state (metadata shipped once at
+        # stream_open; steady-state steps carry only deltas). Keyed by the
+        # connection's socket object; cleaned up when the connection dies.
+        self._streams: Dict[object, Dict[str, dict]] = {}
+        self._streams_lock = threading.Lock()
+        self.stream_opens = 0      # observability: full-metadata (re)opens
+        self.stream_steps = 0      # observability: delta-only steps
         # Several stage servers on one host may SHARE one runtime (one chip,
         # one compute thread): only the owner may start/stop it, otherwise an
         # elastic teardown of server A would kill server B's compute.
         self.owns_runtime = owns_runtime
         super().__init__(host, port)
 
-    def _compute(self, kind: str, fn, *args, size: int = 1):
+    def _compute(self, kind: str, fn, *args, size: int = 1,
+                 timeout: Optional[float] = None):
+        budget = (self.compute_timeout if timeout is None
+                  else min(timeout, self.compute_timeout))
         if self.runtime is None:
             return fn(*args)
-        return self.runtime.call(kind, fn, *args, size=size,
-                                 timeout=self.compute_timeout)
+        return self.runtime.call(kind, fn, *args, size=size, timeout=budget)
 
     def _relay(self, nxt: dict, nreq: StageRequest) -> Tuple[dict, bytes]:
         """Send a push-chain request to the next hop, return its raw response
@@ -441,148 +500,16 @@ class TcpStageServer(_FramedTcpServer):
                                "peer": self.peer_id or "?",
                                "message": "server is re-spanning"})
             return
+        if verb == "stream_open":
+            self._stream_open(sock, header)
+            return
+        if verb == "step":
+            self._stream_step(sock, ex, header, payload)
+            return
         if verb == "forward":
-            req = _header_to_request(header, payload)
-            t_req = time.monotonic()
-            try:
-                resp = self._compute("inference", ex.forward, req,
-                                     size=req.seq_len)
-            # All three map to kind="stage": the client converts that to
-            # StageExecutionError, which is in its retryable taxonomy
-            # (client.py failover) — a crashed generation helps nobody.
-            # TimeoutError must be caught here explicitly: on py>=3.11 it is
-            # an OSError subclass, and the outer handler's socket-error catch
-            # would otherwise silently drop the connection.
-            except (StageExecutionError, TaskRejected) as exc:
-                _send_frame(sock, {"verb": "error", "message": str(exc),
-                                   "kind": "stage",
-                                   "peer": ex.peer_id})
-                return
-            except TimeoutError:
-                _send_frame(sock, {"verb": "error", "kind": "stage",
-                                   "peer": ex.peer_id,
-                                   "message": f"stage compute timed out after "
-                                              f"{self.compute_timeout:.0f}s"})
-                return
-            if resp.is_token:
-                frame = {
-                    "verb": "token", "session_id": resp.session_id,
-                    "token_id": resp.token_id, "cache_len": resp.cache_len,
-                }
-                if resp.token_ids is not None:   # batch>1 per-row sampling
-                    frame["token_ids"] = list(resp.token_ids)
-                _send_frame(sock, frame)
-            elif resp.is_speculative:
-                _send_frame(sock, {
-                    "verb": "spec", "session_id": resp.session_id,
-                    "tokens": list(resp.tokens),
-                    "n_accepted": resp.n_accepted,
-                    "cache_len": resp.cache_len,
-                })
-            elif resp.is_beam:
-                _send_frame(sock, {
-                    "verb": "beam", "session_id": resp.session_id,
-                    "cache_len": resp.cache_len,
-                    "top_tokens": [list(r) for r in resp.top_tokens],
-                    "top_logprobs": [list(r) for r in resp.top_logprobs],
-                })
-            elif req.next_servers:
-                # Push chain (petals handler.py:320-350): ship our output
-                # straight to the next hop and relay its final response back
-                # upstream — the client sees ONE round trip per step.
-                nxt = req.next_servers[0]
-                nreq = dataclasses.replace(
-                    req,
-                    hidden=resp.hidden,
-                    start_block=nxt.get("start_block"),
-                    end_block=nxt.get("end_block"),
-                    next_servers=tuple(req.next_servers[1:]),
-                )
-                try:
-                    rh, rp = self._relay(nxt, nreq)
-                except (ConnectionError, OSError, TimeoutError) as exc:
-                    _send_frame(sock, {
-                        "verb": "error", "kind": "push",
-                        "peer": nxt.get("peer_id", "?"),
-                        "message": f"push to {nxt.get('peer_id')} failed: {exc}",
-                    })
-                    return
-                _send_frame(sock, rh, rp)
-            else:
-                arr = np.asarray(resp.hidden)
-                meta, body = _encode_tensor(arr, self.wire_dtype)
-                _send_frame(sock, {
-                    "verb": "hidden", "session_id": resp.session_id,
-                    "cache_len": resp.cache_len, "tensor": meta,
-                }, body)
-            # Structured per-request record (petals _log_request,
-            # handler.py:549-573): prefills at INFO, per-token decode steps
-            # at DEBUG so steady-state serving doesn't flood logs. Logged
-            # AFTER the response is encoded+sent: JAX dispatch is async, so
-            # only then has the device work for hidden-returning stages
-            # actually materialized — ms covers real compute, not dispatch.
-            logger.log(
-                logging.INFO if req.is_prefill else logging.DEBUG,
-                "req peer=%s session=%s kind=%s span=[%s,%s) T=%d B=%d "
-                "replay=%d ms=%.1f",
-                ex.peer_id, req.session_id,
-                "prefill" if req.is_prefill else "decode",
-                req.start_block, req.end_block, req.seq_len,
-                req.hidden.shape[0], int(req.is_replay),
-                (time.monotonic() - t_req) * 1e3,
-            )
+            self._run_forward(sock, ex, _header_to_request(header, payload))
         elif verb in ("train_forward", "backward"):
-            # QoS via the pool kinds: inference outranks both training verbs
-            # (DummyTaskPrioritizer semantics, petals/server/task_prioritizer.py).
-            tensors = _decode_tensors(header["tensors"], payload)
-            try:
-                if verb == "train_forward":
-                    req = StageRequest(
-                        session_id=header["session_id"],
-                        hidden=jnp.asarray(tensors[0]),
-                        seq_len=header["seq_len"], cur_len=0, is_prefill=False,
-                        max_length=0, train=True,
-                        prompts=(jnp.asarray(tensors[1])
-                                 if len(tensors) > 1 else None),
-                        start_block=header.get("start_block"),
-                        end_block=header.get("end_block"),
-                    )
-                    resp = self._compute("forward", ex.train_forward,
-                                         req, size=req.seq_len)
-                    arr = np.asarray(resp.hidden)
-                    meta, body = _encode_tensor(arr, self.wire_dtype)
-                    _send_frame(sock, {
-                        "verb": "hidden", "session_id": resp.session_id,
-                        "cache_len": 0, "tensor": meta,
-                    }, body)
-                else:
-                    breq = BackwardRequest(
-                        session_id=header["session_id"],
-                        hidden=jnp.asarray(tensors[0]),
-                        grad_output=jnp.asarray(tensors[1]),
-                        seq_len=header["seq_len"],
-                        prompts=(jnp.asarray(tensors[2])
-                                 if len(tensors) > 2 else None),
-                        start_block=header.get("start_block"),
-                        end_block=header.get("end_block"),
-                    )
-                    bresp = self._compute("backward", ex.backward,
-                                          breq, size=breq.seq_len)
-                    arrs = [np.asarray(bresp.grad_input)]
-                    if bresp.grad_prompts is not None:
-                        arrs.append(np.asarray(bresp.grad_prompts))
-                    metas, body = _encode_tensors(arrs, "f32")
-                    _send_frame(sock, {
-                        "verb": "grads", "session_id": bresp.session_id,
-                        "tensors": metas,
-                    }, body)
-            except (StageExecutionError, TaskRejected) as exc:
-                _send_frame(sock, {"verb": "error", "message": str(exc),
-                                   "kind": "stage"})
-            except TimeoutError:
-                _send_frame(sock, {"verb": "error", "kind": "stage",
-                                   "message": f"stage compute timed out after "
-                                              f"{self.compute_timeout:.0f}s"})
+            self._train_verbs(sock, ex, verb, header, payload)
         elif verb == "end_session":
             # Through the runtime's compute thread, NOT inline: freeing the
             # arena handle while a timed-out forward for the same session is
@@ -608,6 +535,253 @@ class TcpStageServer(_FramedTcpServer):
         else:
             _send_frame(sock, {"verb": "error",
                                "message": f"unknown verb {verb!r}"})
+
+    # ------------------------------------------------------------------
+    # Persistent inference streams (petals/server/handler.py:132-308)
+    # ------------------------------------------------------------------
+
+    def _on_connection_closed(self, sock) -> None:
+        with self._streams_lock:
+            self._streams.pop(sock, None)
+
+    def _stream_open(self, sock, header: dict) -> None:
+        """Register a session stream on THIS connection: the full request
+        metadata (sampling, block range, route, recent-token window) ships
+        once; subsequent `step` frames carry only per-step deltas. Re-opening
+        an existing session replaces its metadata (the client does this when
+        sampling params or the route change)."""
+        sid = header["session_id"]
+        state = {
+            "max_length": header.get("max_length", 0),
+            "sampling": SamplingParams(
+                temperature=header.get("temperature", 0.7),
+                top_p=header.get("top_p", 0.9),
+                top_k=header.get("top_k", 50),
+                repetition_penalty=header.get("repetition_penalty", 1.5),
+            ),
+            "start_block": header.get("start_block"),
+            "end_block": header.get("end_block"),
+            "next_servers": tuple(header.get("next_servers", ())),
+            # Server-maintained recent-token window: seeded here, then
+            # appended with every token THIS server samples for the session
+            # — steady-state steps never re-ship it.
+            "generated": list(header.get("generated_tokens", ()))[-50:],
+            # Per-step compute timeout + absolute session deadline
+            # (petals handler.py per-step timeout / session max duration).
+            "step_timeout": header.get("step_timeout"),
+            "deadline": (time.monotonic() + header["deadline_s"]
+                         if header.get("deadline_s") else None),
+        }
+        with self._streams_lock:
+            self._streams.setdefault(sock, {})[sid] = state
+            self.stream_opens += 1
+        _send_frame(sock, {"verb": "ok", "session_id": sid})
+
+    def _stream_step(self, sock, ex, header: dict, payload: bytes) -> None:
+        sid = header["session_id"]
+        with self._streams_lock:
+            state = self._streams.get(sock, {}).get(sid)
+            self.stream_steps += 1
+        if state is None:
+            # stream_closed/reason let the transport distinguish a repairable
+            # desync (re-open + resend transparently) from policy refusals.
+            _send_frame(sock, {"verb": "error", "kind": "stage",
+                               "peer": self.peer_id or "?",
+                               "stream_closed": True, "reason": "no_stream",
+                               "message": f"session {sid}: step without "
+                                          "stream_open on this connection"})
+            return
+        if state["deadline"] is not None and time.monotonic() > state["deadline"]:
+            # Session outlived its declared budget: free the cache and
+            # refuse — the stream analogue of petals' session expiry.
+            with self._streams_lock:
+                self._streams.get(sock, {}).pop(sid, None)
+            try:
+                self._compute("inference", ex.drop_session, sid)
+            except Exception:
+                pass
+            _send_frame(sock, {"verb": "error", "kind": "stage",
+                               "peer": self.peer_id or "?",
+                               "stream_closed": True, "reason": "deadline",
+                               "message": f"session {sid}: deadline exceeded"})
+            return
+        req = StageRequest(
+            session_id=sid,
+            hidden=jnp.asarray(_decode_tensor(header["tensor"], payload)),
+            seq_len=header["seq_len"],
+            cur_len=header["cur_len"],
+            is_prefill=header.get("is_prefill", False),
+            max_length=state["max_length"],
+            sampling=state["sampling"],
+            generated_tokens=tuple(state["generated"]),
+            step_seed=header.get("step_seed", 0),
+            start_block=state["start_block"],
+            end_block=state["end_block"],
+            next_servers=state["next_servers"],
+            start_from_position=header.get("start_from_position"),
+        )
+        self._run_forward(sock, ex, req, stream=state,
+                          step_timeout=state["step_timeout"])
+
+    def _run_forward(self, sock, ex, req: StageRequest, stream: dict = None,
+                     step_timeout: Optional[float] = None) -> None:
+        t_req = time.monotonic()
+        try:
+            resp = self._compute("inference", ex.forward, req,
+                                 size=req.seq_len, timeout=step_timeout)
+        # All three map to kind="stage": the client converts that to
+        # StageExecutionError, which is in its retryable taxonomy
+        # (client.py failover) — a crashed generation helps nobody.
+        # TimeoutError must be caught here explicitly: on py>=3.11 it is
+        # an OSError subclass, and the outer handler's socket-error catch
+        # would otherwise silently drop the connection.
+        except (StageExecutionError, TaskRejected) as exc:
+            _send_frame(sock, {"verb": "error", "message": str(exc),
+                               "kind": "stage",
+                               "peer": ex.peer_id})
+            return
+        except TimeoutError:
+            budget = (step_timeout if step_timeout is not None
+                      else self.compute_timeout)
+            _send_frame(sock, {"verb": "error", "kind": "stage",
+                               "peer": ex.peer_id,
+                               "message": f"stage compute timed out after "
+                                          f"{budget:.0f}s"})
+            return
+        if resp.is_token:
+            if stream is not None and resp.token_id is not None:
+                # Maintain the stream's server-side recent-token window
+                # (the client never re-ships it on the stream path).
+                stream["generated"].append(int(resp.token_id))
+                del stream["generated"][:-50]
+            frame = {
+                "verb": "token", "session_id": resp.session_id,
+                "token_id": resp.token_id, "cache_len": resp.cache_len,
+            }
+            if resp.token_ids is not None:   # batch>1 per-row sampling
+                frame["token_ids"] = list(resp.token_ids)
+            _send_frame(sock, frame)
+        elif resp.is_speculative:
+            _send_frame(sock, {
+                "verb": "spec", "session_id": resp.session_id,
+                "tokens": list(resp.tokens),
+                "n_accepted": resp.n_accepted,
+                "cache_len": resp.cache_len,
+            })
+        elif resp.is_beam:
+            _send_frame(sock, {
+                "verb": "beam", "session_id": resp.session_id,
+                "cache_len": resp.cache_len,
+                "top_tokens": [list(r) for r in resp.top_tokens],
+                "top_logprobs": [list(r) for r in resp.top_logprobs],
+            })
+        elif req.next_servers:
+            # Push chain (petals handler.py:320-350): ship our output
+            # straight to the next hop and relay its final response back
+            # upstream — the client sees ONE round trip per step.
+            nxt = req.next_servers[0]
+            nreq = dataclasses.replace(
+                req,
+                hidden=resp.hidden,
+                start_block=nxt.get("start_block"),
+                end_block=nxt.get("end_block"),
+                next_servers=tuple(req.next_servers[1:]),
+            )
+            try:
+                rh, rp = self._relay(nxt, nreq)
+            except (ConnectionError, OSError, TimeoutError) as exc:
+                _send_frame(sock, {
+                    "verb": "error", "kind": "push",
+                    "peer": nxt.get("peer_id", "?"),
+                    "message": f"push to {nxt.get('peer_id')} failed: {exc}",
+                })
+                return
+            if stream is not None and rh.get("verb") == "token" and (
+                    rh.get("token_id") is not None):
+                # Push chain on a stream: the token was sampled DOWNSTREAM
+                # and only relays through us — append it to this stream's
+                # window too, or the final stage's repetition penalty would
+                # run against the window as of stream_open forever.
+                stream["generated"].append(int(rh["token_id"]))
+                del stream["generated"][:-50]
+            _send_frame(sock, rh, rp)
+        else:
+            arr = np.asarray(resp.hidden)
+            meta, body = _encode_tensor(arr, self.wire_dtype)
+            _send_frame(sock, {
+                "verb": "hidden", "session_id": resp.session_id,
+                "cache_len": resp.cache_len, "tensor": meta,
+            }, body)
+        # Structured per-request record (petals _log_request,
+        # handler.py:549-573): prefills at INFO, per-token decode steps
+        # at DEBUG so steady-state serving doesn't flood logs. Logged
+        # AFTER the response is encoded+sent: JAX dispatch is async, so
+        # only then has the device work for hidden-returning stages
+        # actually materialized — ms covers real compute, not dispatch.
+        logger.log(
+            logging.INFO if req.is_prefill else logging.DEBUG,
+            "req peer=%s session=%s kind=%s span=[%s,%s) T=%d B=%d "
+            "replay=%d ms=%.1f",
+            ex.peer_id, req.session_id,
+            "prefill" if req.is_prefill else "decode",
+            req.start_block, req.end_block, req.seq_len,
+            req.hidden.shape[0], int(req.is_replay),
+            (time.monotonic() - t_req) * 1e3,
+        )
+
+    def _train_verbs(self, sock, ex, verb: str, header: dict,
+                     payload: bytes) -> None:
+        # QoS via the pool kinds: inference outranks both training verbs
+        # (DummyTaskPrioritizer semantics, petals/server/task_prioritizer.py).
+        tensors = _decode_tensors(header["tensors"], payload)
+        try:
+            if verb == "train_forward":
+                req = StageRequest(
+                    session_id=header["session_id"],
+                    hidden=jnp.asarray(tensors[0]),
+                    seq_len=header["seq_len"], cur_len=0, is_prefill=False,
+                    max_length=0, train=True,
+                    prompts=(jnp.asarray(tensors[1])
+                             if len(tensors) > 1 else None),
+                    start_block=header.get("start_block"),
+                    end_block=header.get("end_block"),
+                )
+                resp = self._compute("forward", ex.train_forward,
+                                     req, size=req.seq_len)
+                arr = np.asarray(resp.hidden)
+                meta, body = _encode_tensor(arr, self.wire_dtype)
+                _send_frame(sock, {
+                    "verb": "hidden", "session_id": resp.session_id,
+                    "cache_len": 0, "tensor": meta,
+                }, body)
+            else:
+                breq = BackwardRequest(
+                    session_id=header["session_id"],
+                    hidden=jnp.asarray(tensors[0]),
+                    grad_output=jnp.asarray(tensors[1]),
+                    seq_len=header["seq_len"],
+                    prompts=(jnp.asarray(tensors[2])
+                             if len(tensors) > 2 else None),
+                    start_block=header.get("start_block"),
+                    end_block=header.get("end_block"),
+                )
+                bresp = self._compute("backward", ex.backward,
+                                      breq, size=breq.seq_len)
+                arrs = [np.asarray(bresp.grad_input)]
+                if bresp.grad_prompts is not None:
+                    arrs.append(np.asarray(bresp.grad_prompts))
+                metas, body = _encode_tensors(arrs, "f32")
+                _send_frame(sock, {
+                    "verb": "grads", "session_id": bresp.session_id,
+                    "tensors": metas,
+                }, body)
+        except (StageExecutionError, TaskRejected) as exc:
+            _send_frame(sock, {"verb": "error", "message": str(exc),
+                               "kind": "stage"})
+        except TimeoutError:
+            _send_frame(sock, {"verb": "error", "kind": "stage",
+                               "message": f"stage compute timed out after "
+                                          f"{self.compute_timeout:.0f}s"})
 
     def _reach_check(self, sock, header: dict) -> None:
         """ReachabilityProtocol.rpc_check (petals reachability.py:86-164):
@@ -638,11 +812,22 @@ class TcpTransport(Transport):
     """Client-side transport resolving peers via registry `address` fields."""
 
     def __init__(self, registry, wire_dtype: str = "bf16",
-                 connect_timeout: float = 5.0):
+                 connect_timeout: float = 5.0, use_streams: bool = True,
+                 step_timeout: Optional[float] = None,
+                 session_deadline_s: Optional[float] = None):
         self.registry = registry
         self.wire_dtype = wire_dtype
         self.connect_timeout = connect_timeout
+        # Persistent per-session streams (metadata once, deltas per step).
+        # step_timeout/session_deadline_s are DECLARED to the server at
+        # stream_open: the server enforces them (per-step compute budget,
+        # absolute session lifetime) — petals handler.py:132-195 semantics.
+        self.use_streams = use_streams
+        self.step_timeout = step_timeout
+        self.session_deadline_s = session_deadline_s
         self._conns: Dict[str, socket.socket] = {}
+        # (peer_id, session_id) -> {"snap", "sock", "window", "returns_tokens"}
+        self._streams: Dict[Tuple[str, str], dict] = {}
         self._lock = threading.Lock()
 
     def _addr(self, peer_id: str) -> Tuple[str, int]:
@@ -671,6 +856,10 @@ class TcpTransport(Transport):
     def _drop(self, peer_id: str) -> None:
         with self._lock:
             sock = self._conns.pop(peer_id, None)
+            # Streams live on the dropped connection: forget them so the next
+            # step re-opens (full metadata) on the fresh socket.
+            for key in [k for k in self._streams if k[0] == peer_id]:
+                del self._streams[key]
         if sock is not None:
             try:
                 sock.close()
@@ -700,8 +889,18 @@ class TcpTransport(Transport):
         except (PeerUnavailable, TimeoutError, ConnectionError, OSError):
             return None
 
+    def _streamable(self, request: StageRequest) -> bool:
+        """Plain prefill/decode rides the persistent stream; every exotic
+        request shape (train, beam, speculative, replay) uses the classic
+        full-metadata frame."""
+        return (self.use_streams and not request.train
+                and request.hypo_ids is None and request.num_logprobs == 0
+                and request.draft_tokens is None and not request.is_replay)
+
     def call(self, peer_id: str, request: StageRequest,
              timeout: Optional[float] = None) -> StageResponse:
+        if self._streamable(request):
+            return self._call_stream(peer_id, request, timeout)
         sock = self._connect(peer_id)
         try:
             sock.settimeout(timeout)
@@ -730,6 +929,109 @@ class TcpTransport(Transport):
         except (ConnectionError, OSError) as exc:
             self._drop(peer_id)
             raise PeerUnavailable(f"peer {peer_id} connection failed: {exc}")
+        return self._parse_response(peer_id, header, payload)
+
+    def _call_stream(self, peer_id: str, request: StageRequest,
+                     timeout: Optional[float] = None) -> StageResponse:
+        """Persistent-stream fast path (petals handler.py:132-308): session
+        metadata ships once per (peer, connection) in `stream_open`; steady-
+        state steps carry only {cur_len, seq_len, seed} + the tensor. The
+        transport mirrors the server's recent-token window (the server
+        appends every token it returns on the stream) and re-ships it inline
+        only when the client's window diverges — e.g. the first step back on
+        a peer after tokens were sampled elsewhere during failover."""
+        key = (peer_id, request.session_id)
+        snap = (request.sampling.temperature, request.sampling.top_p,
+                request.sampling.top_k, request.sampling.repetition_penalty,
+                request.max_length, request.start_block, request.end_block,
+                tuple(json.dumps(n, sort_keys=True)
+                      for n in request.next_servers))
+        sock = self._connect(peer_id)
+        try:
+            sock.settimeout(timeout)
+            with self._lock:
+                st = self._streams.get(key)
+                stale = st is None or st["snap"] != snap or st["sock"] is not sock
+            if stale:
+                _send_frame(sock, {
+                    "verb": "stream_open",
+                    "session_id": request.session_id,
+                    "max_length": request.max_length,
+                    "temperature": request.sampling.temperature,
+                    "top_p": request.sampling.top_p,
+                    "top_k": request.sampling.top_k,
+                    "repetition_penalty": request.sampling.repetition_penalty,
+                    "generated_tokens": list(request.generated_tokens),
+                    "start_block": request.start_block,
+                    "end_block": request.end_block,
+                    "next_servers": list(request.next_servers),
+                    "step_timeout": self.step_timeout,
+                    "deadline_s": self.session_deadline_s,
+                })
+                h, _ = _recv_frame(sock)
+                if h.get("verb") != "ok":
+                    self._parse_response(peer_id, h, b"")  # raises
+                    raise WireError(f"bad stream_open reply {h.get('verb')!r}")
+                st = {"snap": snap, "sock": sock,
+                      "window": list(request.generated_tokens)[-50:],
+                      "returns_tokens": None}
+                with self._lock:
+                    self._streams[key] = st
+            hdr = {
+                "verb": "step",
+                "session_id": request.session_id,
+                "seq_len": request.seq_len,
+                "cur_len": request.cur_len,
+                "step_seed": request.step_seed,
+            }
+            if request.is_prefill:
+                hdr["is_prefill"] = True
+            if request.start_from_position is not None:
+                hdr["start_from_position"] = request.start_from_position
+            if st["returns_tokens"] and (
+                    st["window"] != list(request.generated_tokens)[-50:]):
+                # Window drifted (tokens were produced off-stream): re-seed
+                # the server's copy inline rather than re-opening.
+                st["window"] = list(request.generated_tokens)[-50:]
+                # Inline override uses stream_open semantics server-side:
+                # cheapest correct fix is a re-open carrying the window.
+                with self._lock:
+                    self._streams.pop(key, None)
+                return self._call_stream(peer_id, request, timeout)
+            arr = np.asarray(request.hidden)
+            meta, body = _encode_tensor(arr, self.wire_dtype)
+            hdr["tensor"] = meta
+            _send_frame(sock, hdr, body)
+            header, payload = _recv_frame(sock)
+        except socket.timeout as exc:
+            self._drop(peer_id)
+            raise TimeoutError(f"peer {peer_id} timed out") from exc
+        except (ConnectionError, OSError) as exc:
+            self._drop(peer_id)
+            raise PeerUnavailable(f"peer {peer_id} connection failed: {exc}")
+        try:
+            resp = self._parse_response(peer_id, header, payload)
+        except StageExecutionError:
+            if header.get("stream_closed"):
+                # Server no longer holds this stream (deadline, restart, or
+                # connection churn). Forget ours; a pure desync is repaired
+                # transparently by ONE re-open + resend, policy refusals
+                # (deadline) propagate into the client's failover taxonomy.
+                with self._lock:
+                    self._streams.pop(key, None)
+                if header.get("reason") == "no_stream":
+                    return self._call_stream(peer_id, request, timeout)
+            raise
+        if resp.token_id is not None:
+            st["returns_tokens"] = True
+            st["window"].append(int(resp.token_id))
+            del st["window"][:-50]
+        elif resp.hidden is not None and st["returns_tokens"] is None:
+            st["returns_tokens"] = False
+        return resp
+
+    def _parse_response(self, peer_id: str, header: dict,
+                        payload: bytes) -> StageResponse:
         verb = header.get("verb")
         if verb == "spec":
             return StageResponse(
@@ -816,6 +1118,8 @@ class TcpTransport(Transport):
         raise WireError(f"unexpected response verb {header.get('verb')!r}")
 
     def end_session(self, peer_id: str, session_id: str) -> None:
+        with self._lock:
+            self._streams.pop((peer_id, session_id), None)
         try:
             sock = self._connect(peer_id)
             sock.settimeout(self.connect_timeout)

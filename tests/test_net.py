@@ -303,3 +303,129 @@ def test_reach_check_and_direct_reachability(swarm):
                                      b.address) is True
     assert check_direct_reachability(transport, client.registry,
                                      "127.0.0.1:1") is False
+
+
+# ---------------------------------------------------------------------------
+# Persistent per-session streams (petals/server/handler.py:132-308)
+# ---------------------------------------------------------------------------
+
+def test_stream_metadata_ships_once(swarm):
+    """Steady-state decode sends ONE stream_open per (session, hop); every
+    later step is a delta frame, and the final server's recent-token window
+    (maintained server-side) matches what the client generated."""
+    cfg, params, client, transport, servers, _ = swarm
+    sampling = SamplingParams(temperature=0.0)
+    res = client.generate([5, 9, 23, 7], max_new_tokens=6, sampling=sampling)
+    ref = oracle_generate(cfg, params, [5, 9, 23, 7], 6, sampling)
+    assert res.tokens == ref
+    for srv in servers:
+        # 1 open per hop for this session; all decode steps rode deltas.
+        assert srv.stream_opens == 1, srv.executor.peer_id
+        assert srv.stream_steps >= 6
+
+
+def test_stream_sampled_window_parity(swarm):
+    """temperature>0 with repetition penalty: the penalty window lives
+    SERVER-side on the stream path — parity with the oracle proves the
+    server's window tracks the client's exactly."""
+    cfg, params, client, _, _, _ = swarm
+    sampling = SamplingParams(temperature=0.8, top_p=0.9, top_k=40,
+                              repetition_penalty=1.4)
+    res = client.generate([5, 9, 23, 7], max_new_tokens=8, sampling=sampling)
+    ref = oracle_generate(cfg, params, [5, 9, 23, 7], 8, sampling)
+    assert res.tokens == ref
+
+
+@pytest.mark.parametrize("swarm", [2], indirect=True)
+def test_stream_session_failover(swarm):
+    """Kill a hop mid-generation on the STREAM path: the client fails over,
+    re-opens the stream (full metadata, incl. the current token window) on
+    the replacement peer, and the tokens are preserved."""
+    cfg, params, client, transport, servers, _ = swarm
+    sampling = SamplingParams(temperature=0.7, repetition_penalty=1.3)
+    route = client.route()
+    hop = next(h for h in route if h.key == "stage2")
+    victim = next(s for s in servers if s.executor.peer_id == hop.peer_id)
+
+    calls = [0]
+    orig_call = transport.call
+
+    def failing_call(peer_id, req, timeout=None):
+        if peer_id == hop.peer_id and not req.is_prefill and not req.is_replay:
+            calls[0] += 1
+            if calls[0] == 3:
+                victim.stop()
+        return orig_call(peer_id, req, timeout)
+
+    transport.call = failing_call
+    res = client.generate([5, 9, 23, 7], max_new_tokens=8, sampling=sampling)
+    ref = oracle_generate(cfg, params, [5, 9, 23, 7], 8, sampling)
+    assert res.tokens == ref
+    assert client.recoveries >= 1
+    # The replacement server saw a fresh stream_open (metadata re-shipped).
+    replacement = next(s for s in servers
+                       if s.executor.spec.index == victim.executor.spec.index
+                       and s is not victim)
+    assert replacement.stream_opens >= 1
+
+
+def test_stream_step_without_open_refused(swarm):
+    """A raw `step` with no stream_open is a retryable stage error, not a
+    protocol wedge."""
+    import jax.numpy as jnp
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.executor import (
+        StageExecutionError,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.net import (
+        _recv_frame,
+        _send_frame,
+    )
+
+    _, _, _, _, servers, _ = swarm
+    srv = servers[0]
+    host, port = srv.address.rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=5.0) as s:
+        meta, body = _encode_tensor(np.zeros((1, 1), np.int32), "f32")
+        _send_frame(s, {"verb": "step", "session_id": "ghost", "seq_len": 1,
+                        "cur_len": 0, "tensor": meta}, body)
+        h, _ = _recv_frame(s)
+        assert h["verb"] == "error" and h["kind"] == "stage"
+        assert "stream_open" in h["message"]
+
+
+def test_stream_session_deadline_enforced(swarm):
+    """A stream opened with a session deadline refuses steps (and frees the
+    stream) once the deadline passes — server-side lifetime enforcement.
+    The deadline check runs BEFORE compute, so compile time can't race it:
+    prefill lands inside the window, the post-sleep decode step cannot."""
+    import time as _time
+
+    import jax.numpy as jnp
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.executor import (
+        StageExecutionError,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.messages import (
+        StageRequest,
+    )
+
+    cfg, params, client, transport, servers, _ = swarm
+    hop = client.route()[0]  # stage-1 server: consumes hidden [B, T, D]
+    h3 = jnp.zeros((1, 3, cfg.hidden_size), jnp.float32)
+    h1 = jnp.zeros((1, 1, cfg.hidden_size), jnp.float32)
+    # Warm the compile so the prefill step itself is fast.
+    transport.call(hop.peer_id, StageRequest(
+        session_id="warm", hidden=h3, seq_len=3, cur_len=0, is_prefill=True,
+        max_length=16))
+    transport.end_session(hop.peer_id, "warm")
+
+    transport.session_deadline_s = 1.0
+    transport.call(hop.peer_id, StageRequest(
+        session_id="dl", hidden=h3, seq_len=3, cur_len=0, is_prefill=True,
+        max_length=16))
+    _time.sleep(1.5)
+    with pytest.raises(StageExecutionError, match="deadline"):
+        transport.call(hop.peer_id, StageRequest(
+            session_id="dl", hidden=h1, seq_len=1, cur_len=3,
+            is_prefill=False, max_length=16))
