@@ -8,55 +8,26 @@ prefill and decode step functions each compile exactly once.
 
 Softmax accumulates in float32 (matches reference ``block.py:138``: fp32
 softmax), outputs return to the activation dtype (bfloat16 on TPU).
+
+Implementation is pure XLA by DECISION, not omission: a hand-written Pallas
+flash kernel (223 lines, VMEM-streamed KV) lived here through round 1 and
+lost to XLA's fused attention at EVERY shape class tried under the honest
+hard-sync methodology — e.g. 3.5 ms/step (XLA) vs 6.7 ms/step (kernel) at
+S=8192 decode on a 0.5B model, v5e — because the kernel's unfused
+custom-call boundary cost more than its streaming saved. It was deleted in
+round 2 (see docs/PERFORMANCE.md "Flash kernel post-mortem"; history:
+``git log -- **/flash_attention.py``). Revisit only with a measured win on
+real hardware.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 NEG_INF = -1e30
-
-# Flash-attention dispatch: "auto" uses the Pallas kernel on TPU whenever the
-# shape qualifies (bucketed cache >= _MIN_CACHE_LEN), pure XLA elsewhere;
-# "on" forces it (interpret-mode on CPU — for tests); "off" forces the
-# pure-XLA path. DEFAULT IS OFF: measured honestly (hard host-fetch sync,
-# fused-scan decode, v5e) XLA's fused attention beat the kernel at every
-# cache length tried (e.g. 3.5 vs 6.7 ms/step at S=8192 on a 0.5B model) —
-# the kernel's unfused custom-call boundary costs more than its streaming
-# saves on this generation. Revisit per hardware with set_flash_attention.
-_FLASH_MODE = "off"
-
-
-def set_flash_attention(mode: str) -> None:
-    global _FLASH_MODE
-    if mode not in ("auto", "on", "off"):
-        raise ValueError(f"flash mode {mode!r} not in auto/on/off")
-    _FLASH_MODE = mode
-
-
-def _flash_dispatch(s: int, t: int, groups: int, hkv: int, dh: int,
-                    itemsize: int = 2) -> bool:
-    if _FLASH_MODE == "off":
-        return False
-    from .flash_attention import supports_flash
-
-    if _FLASH_MODE == "on":
-        # Forced mode ignores the perf threshold (min cache length) but still
-        # requires the kernel to be ABLE to run the shape.
-        if not supports_flash(s, t, groups, hkv, dh, itemsize,
-                              min_cache_len=0):
-            raise ValueError(
-                f"flash attention forced on but shape (S={s}, T={t}, "
-                f"G={groups}, Hkv={hkv}, Dh={dh}) is unsupported"
-            )
-        return True
-    return (supports_flash(s, t, groups, hkv, dh, itemsize)
-            and jax.default_backend() == "tpu")
 
 
 def update_kv_cache(
@@ -106,19 +77,6 @@ def cached_attention(
     s = k_cache.shape[1]
     hkv = k_cache.shape[2]
     groups = h // hkv
-
-    if _flash_dispatch(s, t, groups, hkv, dh, q.dtype.itemsize):
-        return _flash_diffable(sliding_window, q, k_cache, v_cache, cache_len)
-
-    return _xla_cached_attention(q, k_cache, v_cache, cache_len,
-                                 sliding_window)
-
-
-def _xla_cached_attention(q, k_cache, v_cache, cache_len, sliding_window):
-    b, t, h, dh = q.shape
-    s = k_cache.shape[1]
-    hkv = k_cache.shape[2]
-    groups = h // hkv
     # Keep cache operands in their storage dtype (bf16 on TPU) — converting the
     # whole [B,S,Hkv,Dh] cache to fp32 would double HBM traffic per decode
     # step. fp32 accumulation comes from preferred_element_type instead.
@@ -145,45 +103,3 @@ def _xla_cached_attention(q, k_cache, v_cache, cache_len, sliding_window):
         preferred_element_type=jnp.float32,
     )
     return out.reshape(b, t, h, dh).astype(q.dtype)
-
-
-# ---------------------------------------------------------------------------
-# Differentiable flash wrapper. The Pallas kernel has no VJP rule, but the
-# cache-free TRAINING forward (models/transformer.py stack_forward_train →
-# cached_attention with s == t) can route through it — so the flash path
-# carries a custom_vjp whose backward differentiates the mathematically
-# identical XLA implementation from recomputed residuals (same recompute-
-# don't-store contract as the training RPCs, petals block_functions.py:
-# 106-124). Forward stays kernel-fast; gradients stay exact.
-# ---------------------------------------------------------------------------
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def _flash_diffable(sliding_window, q, k_cache, v_cache, cache_len):
-    from .flash_attention import flash_cached_attention
-
-    return flash_cached_attention(
-        q, k_cache, v_cache, cache_len,
-        sliding_window=sliding_window,
-        interpret=jax.default_backend() != "tpu",
-    )
-
-
-def _flash_diffable_fwd(sliding_window, q, k_cache, v_cache, cache_len):
-    out = _flash_diffable(sliding_window, q, k_cache, v_cache, cache_len)
-    return out, (q, k_cache, v_cache, cache_len)
-
-
-def _flash_diffable_bwd(sliding_window, residuals, g):
-    q, k_cache, v_cache, cache_len = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _xla_cached_attention(q_, k_, v_, cache_len,
-                                                 sliding_window),
-        q, k_cache, v_cache,
-    )
-    dq, dk, dv = vjp(g)
-    # cache_len is integral — its cotangent is the symbolic float0 zero.
-    dlen = np.zeros(jnp.shape(cache_len), jax.dtypes.float0)
-    return dq, dk, dv, dlen
-
-
-_flash_diffable.defvjp(_flash_diffable_fwd, _flash_diffable_bwd)

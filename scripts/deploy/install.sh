@@ -1,0 +1,89 @@
+#!/usr/bin/env bash
+# Install systemd units for the registry and/or a stage server on this host
+# (the runnable analogue of the reference's deploy playbook: unit files +
+# auto-update timer). Usage:
+#
+#   sudo scripts/deploy/install.sh registry        # control-plane host
+#   sudo scripts/deploy/install.sh server          # stage-server host
+#   sudo scripts/deploy/install.sh autoupdate      # hourly git-pull+restart
+set -euo pipefail
+
+ROLE="${1:?usage: install.sh registry|server|autoupdate}"
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+UNIT_DIR="${MPT_UNIT_DIR:-/etc/systemd/system}"
+mkdir -p /etc/mpt
+
+case "$ROLE" in
+registry)
+    [ -f /etc/mpt/registry.env ] || cat > /etc/mpt/registry.env <<'EOF'
+MPT_REGISTRY_PORT=31330
+MPT_TTL=45
+EOF
+    cat > "$UNIT_DIR/mpt-registry.service" <<EOF
+[Unit]
+Description=mini-petals-tpu registry (control plane)
+After=network-online.target
+
+[Service]
+ExecStart=$REPO/scripts/deploy/registry.sh
+Restart=always
+RestartSec=5
+
+[Install]
+WantedBy=multi-user.target
+EOF
+    systemctl daemon-reload
+    systemctl enable --now mpt-registry
+    ;;
+server)
+    [ -f /etc/mpt/server.env ] || cat > /etc/mpt/server.env <<'EOF'
+MPT_REGISTRY=127.0.0.1:31330
+MPT_MODEL=gpt2
+MPT_ROLE=elastic
+MPT_RPC_PORT=31331
+EOF
+    cat > "$UNIT_DIR/mpt-server.service" <<EOF
+[Unit]
+Description=mini-petals-tpu stage server
+After=network-online.target
+
+[Service]
+ExecStart=$REPO/scripts/deploy/serve.sh
+Restart=always
+RestartSec=5
+
+[Install]
+WantedBy=multi-user.target
+EOF
+    systemctl daemon-reload
+    systemctl enable --now mpt-server
+    ;;
+autoupdate)
+    cat > "$UNIT_DIR/mpt-autoupdate.service" <<EOF
+[Unit]
+Description=mini-petals-tpu auto-update (git pull + restart)
+
+[Service]
+Type=oneshot
+ExecStart=$REPO/scripts/deploy/update.sh
+EOF
+    cat > "$UNIT_DIR/mpt-autoupdate.timer" <<'EOF'
+[Unit]
+Description=hourly mini-petals-tpu auto-update
+
+[Timer]
+OnCalendar=hourly
+RandomizedDelaySec=600
+
+[Install]
+WantedBy=timers.target
+EOF
+    systemctl daemon-reload
+    systemctl enable --now mpt-autoupdate.timer
+    ;;
+*)
+    echo "unknown role $ROLE" >&2
+    exit 2
+    ;;
+esac
+echo "[install.sh] $ROLE installed"

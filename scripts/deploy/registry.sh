@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Run the registry (control plane) with crash-restart.
+# Env (or /etc/mpt/registry.env): MPT_REGISTRY_PORT (31330), MPT_TTL (45).
+set -euo pipefail
+
+ENV_FILE="${MPT_ENV:-/etc/mpt/registry.env}"
+[ -f "$ENV_FILE" ] && . "$ENV_FILE"
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+PYTHON="${MPT_PYTHON:-python3}"
+
+backoff=2
+while true; do
+    set +e
+    (cd "$REPO" && "$PYTHON" -m \
+        global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.main \
+        --mode registry --host 0.0.0.0 \
+        --registry_port "${MPT_REGISTRY_PORT:-31330}" --ttl "${MPT_TTL:-45}")
+    rc=$?
+    set -e
+    [ $rc -eq 0 ] && exit 0
+    echo "[registry.sh] exited rc=$rc; restarting in ${backoff}s" >&2
+    sleep "$backoff"
+    backoff=$(( backoff < 60 ? backoff * 2 : 60 ))
+done

@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# Run one stage server with crash-restart — the runnable counterpart of the
+# reference's deploy_direct.sh server loop (scripts/deploy_direct.sh:47-99).
+#
+# Config comes from an env file (default /etc/mpt/server.env, override with
+# MPT_ENV), so the same script serves fixed-split and elastic roles:
+#
+#   MPT_REGISTRY=10.0.0.1:31330     # control plane
+#   MPT_CHECKPOINT=/data/llama-3-8b # local HF checkpoint dir (omit = random)
+#   MPT_MODEL=llama-3-8b            # preset + registry scoping name
+#   MPT_ROLE=elastic                # elastic | fixed
+#   MPT_STAGE=1                     # fixed role: stage index
+#   MPT_SPLITS=8,16,24              # fixed role: stage boundaries
+#   MPT_NUM_BLOCKS=                 # elastic: blocks (empty = auto-size
+#                                   #  from device HBM, quant-aware)
+#   MPT_QUANT=none                  # none | int8 | nf4
+#   MPT_RPC_PORT=31331
+#   MPT_PUBLIC_IP=                  # advertise this IP instead of --host
+#   MPT_EXTRA_ARGS=                 # anything else (e.g. --use_cpu_offload)
+set -euo pipefail
+
+ENV_FILE="${MPT_ENV:-/etc/mpt/server.env}"
+[ -f "$ENV_FILE" ] && . "$ENV_FILE"
+
+: "${MPT_REGISTRY:?set MPT_REGISTRY (host:port of the registry)}"
+MPT_ROLE="${MPT_ROLE:-elastic}"
+MPT_MODEL="${MPT_MODEL:-gpt2}"
+MPT_RPC_PORT="${MPT_RPC_PORT:-31331}"
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+PYTHON="${MPT_PYTHON:-python3}"
+
+args=(--mode serve --registry_addr "$MPT_REGISTRY" --model "$MPT_MODEL"
+      --rpc_port "$MPT_RPC_PORT" --host 0.0.0.0)
+[ -n "${MPT_CHECKPOINT:-}" ] && args+=(--checkpoint "$MPT_CHECKPOINT")
+[ -n "${MPT_PUBLIC_IP:-}" ] && args+=(--public_ip "$MPT_PUBLIC_IP")
+[ -n "${MPT_QUANT:-}" ] && [ "${MPT_QUANT}" != none ] && args+=(--quant "$MPT_QUANT")
+if [ "$MPT_ROLE" = elastic ]; then
+    args+=(--use_load_balancing)
+    [ -n "${MPT_SPLITS:-}" ] && args+=(--splits "$MPT_SPLITS")
+    [ -n "${MPT_NUM_BLOCKS:-}" ] && args+=(--num_blocks "$MPT_NUM_BLOCKS")
+else
+    : "${MPT_STAGE:?fixed role needs MPT_STAGE}"
+    : "${MPT_SPLITS:?fixed role needs MPT_SPLITS}"
+    args+=(--stage "$MPT_STAGE" --splits "$MPT_SPLITS")
+fi
+# shellcheck disable=SC2206
+[ -n "${MPT_EXTRA_ARGS:-}" ] && args+=($MPT_EXTRA_ARGS)
+
+# Crash-restart with backoff (systemd Restart= does this too; the loop makes
+# the bare-script path equally durable — reference deploy_direct.sh behavior).
+backoff=2
+while true; do
+    echo "[serve.sh] starting: $PYTHON -m ..main ${args[*]}" >&2
+    set +e
+    (cd "$REPO" && "$PYTHON" -m \
+        global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.main \
+        "${args[@]}")
+    rc=$?
+    set -e
+    [ $rc -eq 0 ] && exit 0            # clean shutdown (SIGINT handled)
+    echo "[serve.sh] server exited rc=$rc; restarting in ${backoff}s" >&2
+    sleep "$backoff"
+    backoff=$(( backoff < 60 ? backoff * 2 : 60 ))
+done

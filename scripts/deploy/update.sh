@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Auto-update: pull the repo and restart the server services when upstream
+# moved — the reference's auto-update unit pair (deploy playbook) as one
+# idempotent script, safe to run from cron or a systemd timer.
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+cd "$REPO"
+
+git fetch --quiet
+local_rev="$(git rev-parse @)"
+remote_rev="$(git rev-parse '@{u}' 2>/dev/null || echo "$local_rev")"
+if [ "$local_rev" = "$remote_rev" ]; then
+    echo "[update.sh] up to date at ${local_rev:0:12}"
+    exit 0
+fi
+echo "[update.sh] updating ${local_rev:0:12} -> ${remote_rev:0:12}"
+git merge --ff-only '@{u}'
+
+# Restart managed services if systemd runs them; bare serve.sh loops pick up
+# the new code on their next crash-restart cycle (or SIGHUP them manually).
+if command -v systemctl >/dev/null 2>&1; then
+    for unit in mpt-server mpt-registry; do
+        if systemctl is-active --quiet "$unit" 2>/dev/null; then
+            echo "[update.sh] restarting $unit"
+            systemctl restart "$unit"
+        fi
+    done
+fi
