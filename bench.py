@@ -1,34 +1,40 @@
-"""Benchmark: steady-state decode throughput on the real chip.
+"""Benchmark: steady-state decode throughput + HBM roofline fraction.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...} whose
+primary value is the flagship (~1.1B LLaMA-arch) batch-16 fused decode in
+true steady-state tokens/s; per-config results ride in "configs".
 
-Workload: gpt2 (124M, the reference's primary config — README.md:46-53) in
-bfloat16, batch 8, 64-token prefill, 64 fused greedy decode steps.
+Configs (the BASELINE.md north-star spread, sized to one chip):
+  * gpt2 b8            — the reference's primary config (README.md:46-53)
+  * gpt2 b8 S=1024     — same model, long-context cache bucket
+  * flagship 1.1B b1   — latency-bound single-stream decode
+  * flagship 1.1B b16  — throughput decode (the primary metric)
 
-Methodology notes (both matter on tunneled/async backends):
-  * The WHOLE decode runs as ONE jitted lax.scan program — the TPU-idiomatic
-    equivalent of the reference's CUDA-graph decode path
-    (petals/llama/cuda_graphs.py): zero per-step host round trips, XLA
-    replays one compiled while-loop.
-  * Timing is closed by FETCHING the final tokens to the host
-    (np.asarray), not block_until_ready(): on tunneled backends
-    block_until_ready can return before device completion, which silently
-    turns the measurement into dispatch throughput. The final tokens
-    data-depend on every step, so their arrival bounds real completion.
-  * Best of 3 runs with DISTINCT prompts per run (identical inputs can be
-    served from caches on some backends).
-
-The reference publishes no numbers (BASELINE.md), so vs_baseline compares
-against the previous round's own recording (BENCH_r*.json) when present,
-else 1.0.
+Methodology (every choice is load-bearing on a tunneled chip):
+  * ONE jitted lax.scan program per run (runtime.fused_decode) — the
+    CUDA-graph analogue; no per-step host round trips.
+  * Hard sync by FETCHING the final tokens (np.asarray), never
+    block_until_ready() — on this tunnel the latter returns at dispatch,
+    which once inflated "tokens/s" ~60x past the roofline.
+  * **Slope timing.** Each program call pays a fixed ~80-110 ms
+    dispatch/transfer overhead through the tunnel. Timing one call measures
+    mostly that. Each config therefore runs the SAME program at two step
+    counts (S1, S2): true per-step time = (t2 - t1) / (S2 - S1); the
+    intercept is reported as dispatch_ms. Round 1's bench (one 64-step
+    call) under-reported gpt2 b8 ~5x for exactly this reason — vs_baseline
+    against r01 reflects both the methodology fix and real optimizations
+    (see runtime/fused_decode.py: cache-as-carry in-place updates + fused
+    transposed head/argmax, each slope-verified).
+  * Distinct prompts per repetition (identical inputs can be cache-served).
+  * roofline_frac = required bytes/step (weights + mean occupied KV rows)
+    over the device's spec HBM bandwidth — v5e: 819 GB/s. Padded-cache
+    reads beyond occupancy count AGAINST us, as inefficiency.
 """
 
 import glob
 import json
 import re
 import time
-
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -39,58 +45,117 @@ from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.
     get_config,
     init_kv_cache,
     init_params,
+    llama_config,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.fused_decode import (
+    make_fused_decode,
 )
 
-BATCH = 8
-PREFILL = 64
-DECODE_STEPS = 64
-# Cache bucket: smallest power-of-two holding prefill + decode — matches
-# the runtime's bucket policy (runtime/kv_cache.py DEFAULT_BUCKETS), so the
-# bench exercises the same shapes serving does. (128 holds 64+64 exactly;
-# the previous 256 doubled per-step attention-cache traffic for nothing —
-# measured 3002 -> 3397 tok/s on the v5e chip.)
-MAX_LEN = 128
-assert PREFILL + DECODE_STEPS <= MAX_LEN
+# Spec HBM bandwidth by device generation (GB/s). The roofline denominator.
+HBM_SPEC_GBPS = (
+    ("v5 lite", 819), ("v5e", 819), ("v5p", 2765),
+    ("v6 lite", 1640), ("v6e", 1640),
+    ("v4", 1228), ("v3", 900), ("v2", 700),
+)
+
+
+def spec_bw_gbps() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for key, bw in HBM_SPEC_GBPS:
+        if key in kind:
+            return float(bw)
+    return 819.0  # unknown: assume the v5e this repo targets
+
+
+def flagship_cfg():
+    # Mirrors __graft_entry__._flagship_cfg (the ~1.1B LLaMA-arch flagship).
+    return llama_config(
+        vocab_size=32000, hidden_size=2048, num_layers=16, num_heads=16,
+        num_kv_heads=8, intermediate_size=5504, max_position_embeddings=2048,
+    )
+
+
+def param_bytes(params) -> int:
+    return sum(int(np.prod(x.shape)) * x.dtype.itemsize
+               for x in jax.tree.leaves(params))
+
+
+def bench_config(name, cfg, params, *, batch, max_len, s1, s2, prefill=64,
+                 reps=4):
+    """Slope-timed fused decode: returns a per-config result dict."""
+    @jax.jit
+    def do_prefill(params, ids, kc, vc):
+        logits, kc, vc = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
+        return (jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32), kc, vc)
+
+    fn = make_fused_decode(cfg, s2, batch)  # ONE compile serves s1 and s2
+
+    def run(steps, seed, compile_first=False):
+        best = float("inf")
+        for r in range(reps + (1 if compile_first else 0)):
+            ids = jax.random.randint(jax.random.PRNGKey(seed + 100 + r),
+                                     (batch, prefill), 0, cfg.vocab_size,
+                                     jnp.int32)
+            kc, vc = init_kv_cache(cfg, cfg.num_layers, batch, max_len,
+                                   dtype=jnp.bfloat16)
+            tok, kc, vc = do_prefill(params, ids, kc, vc)
+            np.asarray(tok)
+            t0 = time.perf_counter()
+            toks, kc, vc = fn(params, tok, kc, vc, jnp.int32(prefill),
+                              jnp.int32(steps))
+            np.asarray(toks[steps - 1])
+            if not (compile_first and r == 0):   # skip the compile call
+                best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1 = run(s1, seed=11, compile_first=True)
+    t2 = run(s2, seed=22)
+    per_step = (t2 - t1) / (s2 - s1)
+    dispatch = max(0.0, t1 - s1 * per_step)
+
+    wbytes = param_bytes(params)
+    # Mean occupied KV rows over the S2 run (what MUST move per step).
+    occ = prefill + s2 / 2
+    kv_bytes = (2 * cfg.num_layers * batch * occ * cfg.num_kv_heads
+                * cfg.head_dim * 2)  # bf16
+    required = wbytes + kv_bytes
+    bw = spec_bw_gbps() * 1e9
+    return {
+        "tokens_per_s": round(batch / per_step, 2),
+        "step_ms": round(per_step * 1e3, 3),
+        "dispatch_ms": round(dispatch * 1e3, 1),
+        "wall_tokens_per_s": round(batch * s2 / t2, 2),
+        "weight_stream_gbps": round(wbytes / per_step / 1e9, 1),
+        "roofline_frac": round(required / per_step / bw, 3),
+        "batch": batch, "max_len": max_len,
+    }
 
 
 def main():
-    cfg = get_config("gpt2")
-    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.bfloat16)
+    results = {}
 
-    @partial(jax.jit, donate_argnums=(2, 3))
-    def prefill(params, ids, kc, vc):
-        logits, kc, vc = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
-        return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32), kc, vc
+    # Step counts: the S2-S1 delta must dwarf the ±30 ms run-to-run noise of
+    # the ~100 ms fixed dispatch, or the slope is garbage (a 40-step delta
+    # once "measured" 3.4x the roofline). 384 extra steps at 0.5-3 ms/step
+    # is a 200-1200 ms delta — comfortably dominant.
+    S1, S2 = 64, 448
+    gcfg = get_config("gpt2")
+    gparams = init_params(jax.random.PRNGKey(0), gcfg, dtype=jnp.bfloat16)
+    results["gpt2_b8"] = bench_config(
+        "gpt2_b8", gcfg, gparams, batch=8, max_len=512, s1=S1, s2=S2)
+    results["gpt2_b8_s1024"] = bench_config(
+        "gpt2_b8_s1024", gcfg, gparams, batch=8, max_len=1024, s1=S1, s2=S2)
+    del gparams
 
-    @partial(jax.jit, donate_argnums=(2, 3))
-    def decode_all(params, tok, kc, vc):
-        def body(carry, _):
-            tok, kc, vc, cl = carry
-            logits, kc, vc = full_forward(cfg, params, tok[:, None], kc, vc, cl)
-            tok = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-            return (tok, kc, vc, cl + 1), tok
+    fcfg = flagship_cfg()
+    fparams = init_params(jax.random.PRNGKey(0), fcfg, dtype=jnp.bfloat16)
+    results["flagship_1b_b1"] = bench_config(
+        "flagship_1b_b1", fcfg, fparams, batch=1, max_len=512, s1=S1, s2=S2)
+    results["flagship_1b_b16"] = bench_config(
+        "flagship_1b_b16", fcfg, fparams, batch=16, max_len=512, s1=S1, s2=S2)
+    del fparams
 
-        (tok, kc, vc, _), toks = jax.lax.scan(
-            body, (tok, kc, vc, jnp.int32(PREFILL)), None,
-            length=DECODE_STEPS)
-        return toks, kc, vc
-
-    def run(seed: int) -> float:
-        ids = jax.random.randint(jax.random.PRNGKey(seed),
-                                 (BATCH, PREFILL), 0, cfg.vocab_size,
-                                 jnp.int32)
-        kc, vc = init_kv_cache(cfg, cfg.num_layers, BATCH, MAX_LEN,
-                               dtype=jnp.bfloat16)
-        tok, kc, vc = prefill(params, ids, kc, vc)
-        np.asarray(tok)  # hard sync: prefill fully done before the clock
-        t0 = time.perf_counter()
-        toks, kc, vc = decode_all(params, tok, kc, vc)
-        np.asarray(toks[-1])  # hard sync: final step's tokens on host
-        return time.perf_counter() - t0
-
-    run(999)  # compile
-    dt = min(run(s) for s in (1, 2, 3))
-    tokens_per_s = BATCH * DECODE_STEPS / dt
+    primary = results["flagship_1b_b16"]
 
     prev = None
     for path in sorted(glob.glob("BENCH_r*.json"),
@@ -98,17 +163,26 @@ def main():
         try:
             with open(path) as f:
                 rec = json.load(f)
-            if rec.get("unit") == "tokens/s":
-                prev = rec.get("value")
+            parsed = rec.get("parsed", rec)
+            if parsed.get("unit") == "tokens/s":
+                if parsed.get("metric") == "flagship_1b_b16_decode_throughput":
+                    prev = parsed.get("value")
         except Exception:
             pass
-    vs = tokens_per_s / prev if prev else 1.0
+    vs = primary["tokens_per_s"] / prev if prev else 1.0
 
     print(json.dumps({
-        "metric": "gpt2_bf16_b8_decode_throughput",
-        "value": round(tokens_per_s, 2),
+        "metric": "flagship_1b_b16_decode_throughput",
+        "value": primary["tokens_per_s"],
         "unit": "tokens/s",
         "vs_baseline": round(vs, 3),
+        "roofline_frac": primary["roofline_frac"],
+        "device": jax.devices()[0].device_kind,
+        "hbm_spec_gbps": spec_bw_gbps(),
+        "note": ("slope-timed steady state (fixed per-dispatch tunnel "
+                 "overhead excluded; round-1 bench included it). "
+                 "gpt2_b8 r01 comparable: wall_tokens_per_s of gpt2_b8."),
+        "configs": results,
     }))
 
 

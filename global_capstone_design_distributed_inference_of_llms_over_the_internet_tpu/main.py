@@ -606,7 +606,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--speculative_k", type=int, default=0,
                    help="speculative decoding: draft up to K tokens per "
                         "round trip (n-gram prompt lookup), verified by the "
-                        "final stage; greedy only (--temperature 0)")
+                        "final stage (greedy: token-identical; temperature>0: "
+                        "distribution-preserving rejection sampling)")
     p.add_argument("--request_timeout", type=float, default=60.0)
     # Host offload (reference --use_cpu_offload / --keep_layers_on_gpu,
     # src/main.py flag table): span weights in host RAM, streamed per layer.

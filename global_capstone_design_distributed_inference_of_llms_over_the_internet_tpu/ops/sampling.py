@@ -140,6 +140,74 @@ def sample_probs(
     return probs / jnp.maximum(probs.sum(), 1e-20)
 
 
+def speculative_verify(
+    rng: jax.Array,
+    logits: jnp.ndarray,
+    drafts,
+    recent_tokens: jnp.ndarray,
+    num_valid,
+    temperature: float,
+    top_p: float,
+    top_k: int,
+    repetition_penalty: float,
+):
+    """Rejection-sampling verification of K drafted tokens under SAMPLING.
+
+    logits: [K+1, V] fp32 — position i's logits were computed AFTER
+    consuming [last_accepted, d_1..d_i]; drafts: K python ints. Returns
+    (tokens, n_accepted) with len(tokens) == n_accepted + 1 (accepted run +
+    one correction/bonus token).
+
+    The client's draft proposal (n-gram prompt lookup) is DETERMINISTIC —
+    a point mass q = δ(d_i) — so the standard accept rule min(1, p/q)
+    reduces to: accept d_i with probability p_i(d_i); on rejection sample
+    the correction from the residual (p_i - q)+ ∝ p_i with d_i zeroed.
+    This preserves the target distribution EXACTLY per position (the
+    speculative-sampling correctness result for deterministic proposals),
+    so temperature>0 serving gets the same round-trip amortization as
+    greedy without changing its output law.
+
+    The repetition-penalty window evolves as drafts are accepted, so each
+    position's target p_i is evaluated against the window INCLUDING the
+    accepted prefix — identical to what non-speculative decoding would
+    have used. Host-side loop over K (small); each position is one compiled
+    sample_probs call.
+    """
+    k = len(drafts)
+    tokens = []
+    rt, nv = jnp.asarray(recent_tokens), jnp.asarray(num_valid, jnp.int32)
+    args = (
+        jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(top_p, jnp.float32),
+        jnp.asarray(top_k, jnp.int32),
+        jnp.asarray(repetition_penalty, jnp.float32),
+    )
+    for i in range(k):
+        rng, key_u, key_r = jax.random.split(rng, 3)
+        probs = sample_probs(logits[i], rt, nv, *args)
+        d = int(drafts[i])
+        if float(jax.random.uniform(key_u)) < float(probs[d]):
+            tokens.append(d)
+            rt, nv = push_recent(rt, nv, jnp.asarray(d, jnp.int32))
+            continue
+        # Reject: correction from the residual (p with the draft zeroed,
+        # renormalized). p(d) == 1 makes the residual empty — measure-zero
+        # for real logits, but guard by falling back to p itself.
+        residual = probs.at[d].set(0.0)
+        z = residual.sum()
+        residual = jnp.where(z > 0, residual / jnp.maximum(z, 1e-20), probs)
+        tok = int(jax.random.categorical(
+            key_r, jnp.log(jnp.maximum(residual, 1e-20))))
+        tokens.append(tok)
+        return tokens, i
+    # All K accepted: bonus token from the final position's target.
+    rng, key_b = jax.random.split(rng)
+    probs = sample_probs(logits[k], rt, nv, *args)
+    tokens.append(int(jax.random.categorical(
+        key_b, jnp.log(jnp.maximum(probs, 1e-20)))))
+    return tokens, k
+
+
 def sample_token(
     rng: jax.Array,
     logits: jnp.ndarray,

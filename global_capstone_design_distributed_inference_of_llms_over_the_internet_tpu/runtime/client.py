@@ -667,15 +667,14 @@ class PipelineClient:
         """``speculative_k > 0`` enables speculative decoding: per decode
         round the client drafts up to K tokens (``draft_fn(context, k)``,
         default n-gram prompt lookup — runtime.speculative), ships them as
-        one multi-token step, and the final stage verifies greedily —
-        amortizing the per-token pipeline round trip the reference pays
-        (its dominant latency, SURVEY.md §3.2). Greedy-only (temperature 0):
-        acceptance compares against argmax, so the output is token-identical
-        to non-speculative greedy decoding."""
+        one multi-token step, and the final stage verifies — amortizing the
+        per-token pipeline round trip the reference pays (its dominant
+        latency, SURVEY.md §3.2). Greedy (temperature<=0) verification is
+        token-identical to non-speculative greedy decoding; temperature>0
+        uses rejection-sampling verification (accept draft i with prob
+        p_i(d_i), resample the residual on reject), which preserves the
+        sampling distribution exactly."""
         sampling = sampling or SamplingParams()
-        if speculative_k > 0 and not sampling.greedy:
-            raise ValueError("speculative decoding requires greedy sampling "
-                             "(temperature <= 0)")
         session_id = session_id or f"sess-{time.monotonic_ns():x}"
         prompt_len = len(prompt_ids)
         max_length = max_length or (

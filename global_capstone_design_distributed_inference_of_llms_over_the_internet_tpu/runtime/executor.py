@@ -446,22 +446,24 @@ class StageExecutor:
         return out[:, :n]
 
     def _verify_drafts(self, req: StageRequest, outs, handle: KVHandle) -> StageResponse:
-        """Speculative verification on the final stage (greedy accept).
+        """Speculative verification on the final stage.
 
         The request's T = 1 + K positions are [last_accepted, d_1..d_K];
-        logits[i] predict the token AFTER consuming position i, so draft
-        d_{i+1} is accepted while d_{i+1} == argmax(logits[i]). Returns the
-        accepted run plus one correction/bonus token (argmax at the first
-        mismatch — or after the last draft when all K were right), and
-        REWINDS this stage's own KV past the rejected tail so the session is
-        immediately consistent here; upstream stages drop their overhang via
-        the next request's ``start_from_position`` (rewind semantics of
-        petals handler.py:163-168, reused as speculative rollback).
+        logits[i] predict the token AFTER consuming position i. Returns the
+        accepted run plus one correction/bonus token, and REWINDS this
+        stage's own KV past the rejected tail so the session is immediately
+        consistent here; upstream stages drop their overhang via the next
+        request's ``start_from_position`` (rewind semantics of petals
+        handler.py:163-168, reused as speculative rollback).
 
-        Greedy-only by contract: acceptance compares against argmax, which is
-        exactly the temperature<=0 sampler (``src/rpc_handler.py:334-335``
-        applies greedy BEFORE penalties) — so output is token-identical to
-        non-speculative greedy decoding. The client enforces the contract.
+        Greedy (temperature<=0): accept while d_{i+1} == argmax(logits[i]) —
+        token-identical to non-speculative greedy decoding
+        (``src/rpc_handler.py:334-335`` applies greedy before penalties).
+        Sampled (temperature>0): rejection-sampling verification
+        (ops.sampling.speculative_verify) — accept draft i with probability
+        p_i(d_i), resample the residual on reject — which preserves the
+        sampling distribution exactly, so temperature>0 gets the same
+        round-trip amortization.
         """
         drafts = np.asarray(req.draft_tokens, np.int64)
         k = int(drafts.shape[0])
@@ -472,6 +474,28 @@ class StageExecutor:
                 "(want K+1)"
             )
         logits = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+        if not req.sampling.greedy:
+            from ..ops.sampling import speculative_verify
+
+            recent = np.zeros((RECENT_WINDOW,), np.int32)
+            n = min(len(req.generated_tokens), RECENT_WINDOW)
+            if n:
+                recent[:n] = np.asarray(req.generated_tokens[-n:], np.int32)
+            sp = req.sampling
+            toks, n_acc = speculative_verify(
+                jax.random.PRNGKey(req.step_seed),
+                logits[0].astype(jnp.float32),
+                [int(d) for d in drafts], recent, n,
+                sp.temperature, sp.top_p, sp.top_k, sp.repetition_penalty)
+            tokens = tuple(int(t) for t in toks)
+            valid = req.cur_len + n_acc + 1
+            try:
+                handle.rewind(valid)
+            except ValueError as exc:  # pragma: no cover - defensive
+                raise StageExecutionError(str(exc)) from exc
+            return StageResponse(
+                session_id=req.session_id, tokens=tokens, n_accepted=n_acc,
+                cache_len=handle.cache_len)
         preds = np.asarray(jnp.argmax(logits[0], axis=-1))  # [T]
         n_acc = 0
         while n_acc < k and int(preds[n_acc]) == int(drafts[n_acc]):
