@@ -243,13 +243,71 @@ def run_fused(args, cfg: ModelConfig, params) -> int:
 
 
 def run_oracle(args, cfg: ModelConfig, params) -> int:
-    """Single-device unpartitioned generation (scripts/single_gpu_check.py)."""
+    """Single-device unpartitioned generation (scripts/single_gpu_check.py).
+
+    Greedy (temperature<=0) rides the fused multi-step engine
+    (runtime.fused_decode): whole chunks of decode run as ONE compiled
+    program with stop conditions checked between chunks — the CUDA-graph
+    replay the reference's oracle lacks. Sampled decoding keeps the
+    per-token loop (the sampler needs host-visible logits each step)."""
     from .ops.sampling import RECENT_WINDOW, sample_token
+
+    def generate_greedy(prompt_ids, max_new_tokens, sampling,
+                        eos_token_id=None, **_kw):
+        from .runtime.client import GenerationResult
+        from .runtime.fused_decode import make_fused_decode
+
+        chunk = min(max_new_tokens, 32)
+        max_len = max(128, len(prompt_ids) + max_new_tokens + 1)
+        kc, vc = init_kv_cache(cfg, cfg.num_layers, 1, max_len,
+                               dtype=params["embed"]["wte"].dtype)
+        ids = jnp.asarray(np.asarray(prompt_ids, np.int32)[None, :])
+        t0 = time.monotonic()
+        logits, kc, vc = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
+        tokens = [int(jnp.argmax(logits[0, -1]))]
+        ttft = time.monotonic() - t0
+        fn = make_fused_decode(cfg, chunk, 1)
+        cur = len(prompt_ids)
+        decode_times: List[float] = []
+        stopped = "max_tokens"
+        while len(tokens) < max_new_tokens and stopped == "max_tokens":
+            if eos_token_id is not None and tokens[-1] == eos_token_id:
+                stopped = "eos"
+                break
+            if len(tokens) >= 5 and len(set(tokens[-5:])) == 1:
+                stopped = "repeat"
+                break
+            n = min(chunk, max_new_tokens - len(tokens))
+            t0 = time.monotonic()
+            toks, kc, vc = fn(params, jnp.asarray([tokens[-1]], jnp.int32),
+                              kc, vc, jnp.int32(cur), jnp.int32(n))
+            got = [int(t) for t in np.asarray(toks[:n, 0])]
+            dt = time.monotonic() - t0
+            decode_times.extend([dt / n] * n)
+            # Stop conditions re-checked PER TOKEN inside the chunk: the
+            # fused program may overshoot an EOS/repeat point; trim so the
+            # output matches the per-token loop exactly up to the stop.
+            for tok in got:
+                tokens.append(tok)
+                cur += 1
+                if eos_token_id is not None and tok == eos_token_id:
+                    stopped = "eos"
+                    break
+                if len(tokens) >= 5 and len(set(tokens[-5:])) == 1:
+                    stopped = "repeat"
+                    break
+        return GenerationResult(
+            tokens=tokens[:max_new_tokens], ttft_s=ttft,
+            decode_times_s=decode_times[:max(len(tokens) - 1, 0)],
+            stopped_by=stopped)
 
     def generate(prompt_ids, max_new_tokens, sampling, eos_token_id=None,
                  **_kw):
         from .runtime.client import GenerationResult
 
+        if sampling.greedy:
+            return generate_greedy(prompt_ids, max_new_tokens, sampling,
+                                   eos_token_id=eos_token_id, **_kw)
         max_len = len(prompt_ids) + max_new_tokens + 1
         kc, vc = init_kv_cache(cfg, cfg.num_layers, 1, max(128, max_len),
                                dtype=params["embed"]["wte"].dtype)

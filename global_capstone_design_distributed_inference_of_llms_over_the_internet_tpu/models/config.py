@@ -34,6 +34,11 @@ class ModelConfig:
     attn_qkv_bias: bool = False    # qwen2: biases on q/k/v ONLY (not o, not mlp)
     tie_word_embeddings: bool = True
     rope_theta: float = 10000.0
+    # Llama-3.1-style RoPE frequency scaling (HF rope_scaling type "llama3"):
+    # (factor, low_freq_factor, high_freq_factor,
+    #  original_max_position_embeddings). None = unscaled RoPE. A tuple, not
+    # a dict, so the frozen config stays hashable.
+    rope_scaling: Optional[tuple] = None
     norm_eps: float = 1e-5
     sliding_window: Optional[int] = None  # mistral
 
@@ -154,6 +159,13 @@ PRESETS = {
         num_kv_heads=8, intermediate_size=28672, max_position_embeddings=8192,
         rope_theta=500000.0,
     ),
+    # Llama-3.1: the reference's LB test model (BASELINE.md: Llama-3.1-8B,
+    # total_blocks=32) — 128k context via the llama3 RoPE frequency remap.
+    "llama-3.1-8b": lambda: dataclasses.replace(llama_config(
+        vocab_size=128256, hidden_size=4096, num_layers=32, num_heads=32,
+        num_kv_heads=8, intermediate_size=14336,
+        max_position_embeddings=131072, rope_theta=500000.0,
+    ), rope_scaling=(8.0, 1.0, 4.0, 8192)),
     "mixtral-8x7b": lambda: mixtral_config(
         vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
         num_kv_heads=8, intermediate_size=14336,

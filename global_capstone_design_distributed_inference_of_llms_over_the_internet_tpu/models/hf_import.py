@@ -79,7 +79,27 @@ def config_from_hf(hf_cfg) -> ModelConfig:
         norm_eps=getattr(hf_cfg, "rms_norm_eps", 1e-5),
     )
     if mt == "llama":
-        return llama_config(**common)
+        cfg = llama_config(**common)
+        rs = getattr(hf_cfg, "rope_scaling", None)
+        if rs:
+            rtype = rs.get("rope_type", rs.get("type"))
+            if rtype == "llama3":
+                # Llama-3.1/3.2 frequency remap (ops.rotary llama3 rule).
+                import dataclasses
+
+                cfg = dataclasses.replace(cfg, rope_scaling=(
+                    float(rs["factor"]),
+                    float(rs.get("low_freq_factor", 1.0)),
+                    float(rs.get("high_freq_factor", 4.0)),
+                    int(rs.get("original_max_position_embeddings", 8192)),
+                ))
+            elif rtype not in (None, "default"):
+                # Linear/dynamic-NTK etc. would silently change positions —
+                # fail loudly rather than generate subtly wrong long-context.
+                raise ValueError(
+                    f"unsupported rope_scaling type {rtype!r} "
+                    "(supported: llama3)")
+        return cfg
     if mt == "qwen2":
         common["norm_eps"] = getattr(hf_cfg, "rms_norm_eps", 1e-6)
         cfg = qwen2_config(**common)

@@ -220,7 +220,8 @@ def make_rope(cfg: ModelConfig, positions: jnp.ndarray):
     layer would cost num_layers rebuilds (80x for llama-3-70b)."""
     if cfg.positional != "rope":
         return None
-    return rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    return rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
+                        cfg.rope_scaling)
 
 
 def _attention(

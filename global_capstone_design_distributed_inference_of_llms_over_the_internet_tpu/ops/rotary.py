@@ -14,19 +14,40 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def rope_frequencies(head_dim: int, theta: float) -> jnp.ndarray:
-    """Inverse frequencies, shape [head_dim // 2], float32."""
+def rope_frequencies(head_dim: int, theta: float,
+                     scaling=None) -> jnp.ndarray:
+    """Inverse frequencies, shape [head_dim // 2], float32.
+
+    ``scaling`` = (factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings) applies the Llama-3.1 "llama3"
+    frequency remap (HF ``_compute_llama3_parameters``): wavelengths past
+    ``orig_max/low_freq_factor`` are slowed by ``factor``, wavelengths
+    below ``orig_max/high_freq_factor`` are untouched, and the band
+    between interpolates smoothly.
+    """
     exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
-    return 1.0 / (theta ** exponents)
+    inv_freq = 1.0 / (theta ** exponents)
+    if scaling is None:
+        return inv_freq
+    factor, low_ff, high_ff, orig_max = scaling
+    wavelen = 2.0 * jnp.pi / inv_freq
+    low_wl = orig_max / low_ff          # longest unscaled-ish wavelength
+    high_wl = orig_max / high_ff        # shortest scaled wavelength
+    smooth = (orig_max / wavelen - low_ff) / (high_ff - low_ff)
+    smoothed = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    out = jnp.where(wavelen > low_wl, inv_freq / factor,
+                    jnp.where(wavelen < high_wl, inv_freq, smoothed))
+    return out
 
 
-def rope_cos_sin(positions: jnp.ndarray, head_dim: int, theta: float):
+def rope_cos_sin(positions: jnp.ndarray, head_dim: int, theta: float,
+                 scaling=None):
     """cos/sin tables for integer positions.
 
     positions: int array [...]; returns (cos, sin) each [..., head_dim] float32,
     with the HF duplicated-half layout: angles = concat([freqs*pos, freqs*pos]).
     """
-    inv_freq = rope_frequencies(head_dim, theta)
+    inv_freq = rope_frequencies(head_dim, theta, scaling)
     angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., hd/2]
     angles = jnp.concatenate([angles, angles], axis=-1)  # [..., hd]
     return jnp.cos(angles), jnp.sin(angles)

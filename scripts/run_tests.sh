@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Full-suite runner with PER-FILE process isolation: each test file gets its
+# own interpreter, so cumulative compile memory (hundreds of cache-disabled
+# XLA compiles) can't segfault the whole run — observed at ~80% of a
+# single-process full suite. Also survives one file crashing.
+#
+#   scripts/run_tests.sh            # all of tests/
+#   scripts/run_tests.sh -m smoke   # extra pytest args forwarded
+set -uo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+# Bypass the axon plugin registration: tests are CPU-only and the shared
+# remote-compile service both adds latency and can be wedged (see
+# .claude/skills/verify/SKILL.md "Compile service hazard").
+export PALLAS_AXON_POOL_IPS=
+
+fail=0
+failed_files=()
+for f in tests/test_*.py; do
+    echo "=== $f"
+    python -m pytest "$f" -q "$@"
+    rc=$?
+    if [ $rc -ne 0 ] && [ $rc -ne 5 ]; then   # 5 = no tests collected (markers)
+        fail=1
+        failed_files+=("$f")
+    fi
+done
+echo
+if [ $fail -ne 0 ]; then
+    echo "FAILED files: ${failed_files[*]}"
+else
+    echo "ALL FILES PASSED"
+fi
+exit $fail

@@ -180,3 +180,50 @@ def test_greedy_generation_token_identical(family):
         cache_len += 1
 
     assert out == list(ref), f"ours={out} ref={list(ref)}"
+
+
+def test_llama31_rope_scaling_matches_hf():
+    """Llama-3.1-style rope_scaling (type "llama3"): logits must match the
+    HF implementation of the frequency remap — the reference's LB test
+    model is Llama-3.1-8B (BASELINE.md)."""
+    torch.manual_seed(0)
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=320, hidden_size=64, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=128,
+        max_position_embeddings=256, rope_theta=10000.0,
+        tie_word_embeddings=False,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 32},
+    )
+    hf_model = LlamaForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_model.config)
+    assert cfg.rope_scaling == (8.0, 1.0, 4.0, 32)
+    params = convert_state_dict(cfg, hf_model.state_dict())
+
+    # Long enough that scaled wavelengths actually matter (> orig_max/2).
+    ids = np.arange(48, dtype=np.int32)[None, :] % 320
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    kc, vc = init_kv_cache(cfg, cfg.num_layers, batch=1, max_len=64)
+    logits, _, _ = full_forward(cfg, params, jnp.asarray(ids), kc, vc,
+                                jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=8e-3, rtol=1e-2)
+    assert (np.asarray(logits).argmax(-1) == ref.argmax(-1)).all()
+
+
+def test_unsupported_rope_scaling_rejected():
+    import pytest as _pytest
+
+    torch.manual_seed(0)
+    from transformers import LlamaConfig
+
+    hf_cfg = LlamaConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, num_key_value_heads=2, intermediate_size=64,
+        rope_scaling={"rope_type": "linear", "factor": 2.0},
+    )
+    with _pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf(hf_cfg)
