@@ -266,7 +266,7 @@ def run_oracle(args, cfg: ModelConfig, params) -> int:
         logits, kc, vc = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
         tokens = [int(jnp.argmax(logits[0, -1]))]
         ttft = time.monotonic() - t0
-        fn = make_fused_decode(cfg, chunk, 1)
+        fn = make_fused_decode(cfg, chunk, 1, exact_head=True)
         cur = len(prompt_ids)
         decode_times: List[float] = []
         stopped = "max_tokens"
@@ -283,19 +283,23 @@ def run_oracle(args, cfg: ModelConfig, params) -> int:
                               kc, vc, jnp.int32(cur), jnp.int32(n))
             got = [int(t) for t in np.asarray(toks[:n, 0])]
             dt = time.monotonic() - t0
-            decode_times.extend([dt / n] * n)
             # Stop conditions re-checked PER TOKEN inside the chunk: the
             # fused program may overshoot an EOS/repeat point; trim so the
             # output matches the per-token loop exactly up to the stop.
+            kept = 0
             for tok in got:
                 tokens.append(tok)
                 cur += 1
+                kept += 1
                 if eos_token_id is not None and tok == eos_token_id:
                     stopped = "eos"
                     break
                 if len(tokens) >= 5 and len(set(tokens[-5:])) == 1:
                     stopped = "repeat"
                     break
+            # The chunk's FULL wall time amortizes over the KEPT tokens, so
+            # the reported tokens/s doesn't inflate when a stop overshoots.
+            decode_times.extend([dt / max(kept, 1)] * kept)
         return GenerationResult(
             tokens=tokens[:max_new_tokens], ttft_s=ttft,
             decode_times_s=decode_times[:max(len(tokens) - 1, 0)],

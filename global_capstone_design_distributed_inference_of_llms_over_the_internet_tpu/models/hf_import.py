@@ -78,9 +78,18 @@ def config_from_hf(hf_cfg) -> ModelConfig:
         tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
         norm_eps=getattr(hf_cfg, "rms_norm_eps", 1e-5),
     )
+    rs = getattr(hf_cfg, "rope_scaling", None)
+    if rs and mt != "llama":
+        # Only the llama3 remap is implemented; any other family shipping
+        # rope_scaling (e.g. yarn on long-context qwen2) would get silently
+        # wrong positions past the base window — fail loudly instead.
+        rtype = rs.get("rope_type", rs.get("type"))
+        if rtype not in (None, "default"):
+            raise ValueError(
+                f"{mt} checkpoint carries rope_scaling type {rtype!r} — "
+                "unsupported (llama3-type scaling on llama only)")
     if mt == "llama":
         cfg = llama_config(**common)
-        rs = getattr(hf_cfg, "rope_scaling", None)
         if rs:
             rtype = rs.get("rope_type", rs.get("type"))
             if rtype == "llama3":

@@ -41,7 +41,8 @@ from ..models.transformer import _norm, layer_forward, make_rope
 Params = Dict[str, Any]
 
 
-def make_fused_decode(cfg: ModelConfig, max_steps: int, batch: int):
+def make_fused_decode(cfg: ModelConfig, max_steps: int, batch: int,
+                      exact_head: bool = False):
     """Build a jitted fused decode program with a DYNAMIC step count.
 
     Returns ``fn(params, tok, kc, vc, start, n) -> (toks, kc, vc)``:
@@ -50,6 +51,12 @@ def make_fused_decode(cfg: ModelConfig, max_steps: int, batch: int):
     ``n``: scalar int32 number of steps (<= max_steps, traced — one compile
     serves every step count, which is what makes slope timing affordable).
     ``toks``: [max_steps, B]; rows >= n are zero.
+
+    ``exact_head=True`` runs the head matmul in fp32 like ``lm_head`` does —
+    bit-matching the per-token sampler's greedy argmax on reduced-precision
+    checkpoints (near-tied logits can otherwise flip under the bf16 one-pass
+    head). The oracle baseline uses it; the bench keeps the fast weight-dtype
+    head (the measured ~1.5x).
     """
     L = cfg.num_layers
 
@@ -57,10 +64,10 @@ def make_fused_decode(cfg: ModelConfig, max_steps: int, batch: int):
         # h: [B, D] -> greedy token [B] via the transposed head matmul.
         if cfg.tie_word_embeddings:
             w = params["embed"]["wte"]                    # [V, D]
-            logits_t = w @ h.T.astype(w.dtype)            # [V, B]
         else:
-            w = params["lm_head"]["w"]                    # [D, V]
-            logits_t = w.T @ h.T.astype(w.dtype)          # [V, B]
+            w = params["lm_head"]["w"].T                  # [V, D] (folded)
+        dt = jnp.float32 if exact_head else w.dtype
+        logits_t = w.astype(dt) @ h.T.astype(dt)          # [V, B]
         return jnp.argmax(logits_t.astype(jnp.float32), axis=0).astype(
             jnp.int32)
 

@@ -227,3 +227,17 @@ def test_unsupported_rope_scaling_rejected():
     )
     with _pytest.raises(ValueError, match="rope_scaling"):
         config_from_hf(hf_cfg)
+
+
+def test_non_llama_rope_scaling_rejected():
+    import pytest as _pytest
+
+    torch.manual_seed(0)
+    from transformers import Qwen2Config
+
+    hf_cfg = Qwen2Config(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, num_key_value_heads=2, intermediate_size=64,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0})
+    with _pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf(hf_cfg)
