@@ -202,6 +202,31 @@ def test_adapter_serves_concurrent_clients_through_transport():
                                  slice_stage_params(cfg, params, spec),
                                  slots=4, max_len=64)
     adapter = BatchingStageAdapter(inner, window_s=0.05, peer_id="batched")
+
+    # Diagnostic trace: this test has flaked rarely under heavy load with a
+    # deterministic-looking 2-step state rewind that no standalone repro
+    # (scripts/repro_adapter_flake.py, 15 loaded trials) reproduces. Record
+    # every request/outcome so the NEXT in-suite failure carries its own
+    # event history instead of just a token diff.
+    import time as _time
+
+    trace = []
+    _orig_forward = adapter.forward
+
+    def traced_forward(req):
+        rec = [_time.monotonic(), req.session_id, req.cur_len,
+               "prefill" if req.is_prefill else "decode", None]
+        trace.append(rec)
+        try:
+            resp = _orig_forward(req)
+        except Exception as exc:
+            rec[4] = f"ERR:{exc}"
+            raise
+        rec[4] = (f"tok={resp.token_id}" if resp.token_id is not None
+                  else "hidden")
+        return resp
+
+    adapter.forward = traced_forward
     transport = LocalTransport()
     transport.add_peer("batched", adapter)
     registry = PlacementRegistry(rng=random.Random(0))
@@ -232,8 +257,15 @@ def test_adapter_serves_concurrent_clients_through_transport():
         t.join(timeout=600)
     assert all(r is not None for r in results), "client thread(s) timed out"
     for i, prompt in enumerate(prompts):
-        assert results[i] == oracle_generate(cfg, params, prompt, n_new,
-                                             sampling), i
+        want = oracle_generate(cfg, params, prompt, n_new, sampling)
+        if results[i] != want:
+            t0 = trace[0][0] if trace else 0.0
+            dump = "\n".join(
+                f"  {t - t0:8.4f}s {sid} cur={cur} {kind} -> {out}"
+                for t, sid, cur, kind, out in trace)
+            raise AssertionError(
+                f"client {i}: got {results[i]} want {want}\n"
+                f"adapter event trace:\n{dump}")
     # Coalescing is asserted deterministically (barrier-synchronized) in
     # test_adapter_coalesces_concurrent_decodes — under heavy CPU contention
     # these free-running clients can legitimately serialize, so a step-count
