@@ -312,8 +312,35 @@ def stack_forward(
     """Run a span of stacked layers via lax.scan.
 
     layers: pytree with leading layer axis L. k_caches/v_caches: [L,B,S,Hkv,Dh].
+
+    Decode steps (T == 1, static under jit) carry the caches through the
+    scan and update one layer's rows in place via dynamic indexing instead
+    of threading them as xs/ys — the xs/ys structure makes XLA rewrite
+    every layer's WHOLE cache every step, slope-measured 1.5x slower at
+    long caches (see runtime/fused_decode.py and docs/PERFORMANCE.md).
+    Identical math either way; prefill (T > 1, cache traffic amortized
+    over T tokens) keeps the simpler xs/ys form.
     """
     rope = make_rope(cfg, positions)
+
+    if x.shape[1] == 1:
+        L = k_caches.shape[0]
+
+        def body1(carry, xs):
+            h, kc, vc = carry
+            li, lp = xs
+            kci = jax.lax.dynamic_index_in_dim(kc, li, 0, keepdims=False)
+            vci = jax.lax.dynamic_index_in_dim(vc, li, 0, keepdims=False)
+            h, kci, vci = layer_forward(cfg, lp, h, rope, kci, vci,
+                                        cache_len, tp_axis)
+            kc = jax.lax.dynamic_update_index_in_dim(kc, kci, li, 0)
+            vc = jax.lax.dynamic_update_index_in_dim(vc, vci, li, 0)
+            return (h, kc, vc), None
+
+        (x, k_caches, v_caches), _ = jax.lax.scan(
+            body1, (x, k_caches, v_caches),
+            (jnp.arange(L, dtype=jnp.int32), layers))
+        return x, k_caches, v_caches
 
     def body(h, xs):
         lp, kc, vc = xs

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.config import ModelConfig
-from ..models.transformer import _norm, layer_forward, make_rope
+from ..models.transformer import _norm, stack_forward
 
 Params = Dict[str, Any]
 
@@ -88,21 +88,11 @@ def make_fused_decode(cfg: ModelConfig, max_steps: int, batch: int,
             if cfg.positional == "learned":
                 p = jnp.clip(pos, 0, cfg.max_position_embeddings - 1)
                 x = x + jnp.take(params["embed"]["wpe"], p, axis=0)
-            rope = make_rope(cfg, pos)
-
-            def layer_body(h_caches, xs):
-                h, kc, vc = h_caches
-                li, lp = xs
-                kci = jax.lax.dynamic_index_in_dim(kc, li, 0, keepdims=False)
-                vci = jax.lax.dynamic_index_in_dim(vc, li, 0, keepdims=False)
-                h, kci, vci = layer_forward(cfg, lp, h, rope, kci, vci, cl)
-                kc = jax.lax.dynamic_update_index_in_dim(kc, kci, li, 0)
-                vc = jax.lax.dynamic_update_index_in_dim(vc, vci, li, 0)
-                return (h, kc, vc), None
-
-            (h, kc, vc), _ = jax.lax.scan(
-                layer_body, (x, kc, vc),
-                (jnp.arange(L), params["layers"]))
+            # T == 1, so stack_forward takes its cache-carrying decode fast
+            # path (ONE shared implementation of the per-layer in-place
+            # update — models/transformer.py).
+            h, kc, vc = stack_forward(cfg, params["layers"], x, pos, kc, vc,
+                                      cl)
             h = _norm(cfg, params["final_norm"], h)[:, 0]
             tok = head_argmax(params, h)
             toks = jax.lax.dynamic_update_index_in_dim(toks, tok, i, 0)
