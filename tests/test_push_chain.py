@@ -159,3 +159,51 @@ def test_push_chain_over_tcp():
     finally:
         for srv in servers:
             srv.stop()
+
+
+def test_push_chain_over_tcp_sampled_stream_window():
+    """Push chain + persistent streams + temperature>0: the first hop's
+    stream must append tokens that were sampled DOWNSTREAM and only relayed
+    through it, or the final stage's repetition-penalty window freezes at
+    stream_open contents (review finding). Parity with the oracle sampler
+    over enough steps that the window materially matters proves the relay
+    append works."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.net import (
+        TcpStageServer,
+        TcpTransport,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("2,4,6"))
+    registry = PlacementRegistry(rng=random.Random(0))
+    servers = []
+    try:
+        for spec in plan.stages[1:]:
+            peer = f"tcp-sw-s{spec.index}"
+            ex = StageExecutor(cfg, spec, slice_stage_params(cfg, params, spec),
+                               peer_id=peer)
+            srv = TcpStageServer(ex, wire_dtype="f32")
+            srv.start()
+            servers.append(srv)
+            rec = make_server_record(peer, spec)
+            rec.address = srv.address
+            registry.register(rec)
+        stage0 = StageExecutor(cfg, plan.stages[0],
+                               slice_stage_params(cfg, params, plan.stages[0]),
+                               peer_id="client-local")
+        transport = TcpTransport(registry, wire_dtype="f32")
+        assert transport.use_streams
+        client = PipelineClient(cfg, plan, stage0, transport, registry,
+                                settle_seconds=0.0, use_push_chain=True)
+        sampling = SamplingParams(temperature=0.8, top_p=0.95, top_k=50,
+                                  repetition_penalty=1.6)
+        prompt = [5, 9, 23]
+        res = client.generate(prompt, max_new_tokens=10, sampling=sampling)
+        ref = oracle_generate(cfg, params, prompt, 10, sampling)
+        assert res.tokens == ref
+        # And the stream actually carried the steps (one open per hop).
+        assert servers[0].stream_opens >= 1 and servers[0].stream_steps >= 9
+    finally:
+        for srv in servers:
+            srv.stop()
