@@ -132,7 +132,23 @@ def bench_config(name, cfg, params, *, batch, max_len, s1, s2, prefill=64,
 
 
 def main():
+    import sys
+
     results = {}
+
+    if "--smoke" in sys.argv:
+        # Structural validation on whatever backend is available (CPU-safe):
+        # tiny model, the full slope/JSON machinery. NOT a perf number.
+        cfg = llama_config(vocab_size=256, hidden_size=64, num_layers=4,
+                           num_heads=4, num_kv_heads=2, intermediate_size=128,
+                           max_position_embeddings=256)
+        params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.bfloat16)
+        r = bench_config("smoke", cfg, params, batch=2, max_len=128,
+                         s1=8, s2=48, prefill=8, reps=2)
+        print(json.dumps({"metric": "smoke", "value": r["tokens_per_s"],
+                          "unit": "tokens/s", "vs_baseline": 1.0,
+                          "configs": {"smoke": r}}))
+        return
 
     # Step counts: the S2-S1 delta must dwarf the ±30 ms run-to-run noise of
     # the ~100 ms fixed dispatch, or the slope is garbage (a 40-step delta
