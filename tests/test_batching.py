@@ -456,3 +456,25 @@ def test_batched_stage_pipeline_matches_oracle():
             toks[sid].append(int(jnp.argmax(s1.logits(h)[0, -1])))
     for sid, prompt in prompts.items():
         assert toks[sid] == oracle_tokens(cfg, params, prompt, n_new), sid
+
+
+def test_batched_mixtral_moe_matches_oracle():
+    """MoE (Mixtral) on the batched path: the dense-routed expert MLP runs
+    inside the slot-batched step; token parity with the per-session oracle.
+    Short horizon: random-weight routers sit near top-k ties, so long runs
+    would test fp noise, not the engine (see test_models_oracle note)."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+        mixtral_config,
+    )
+
+    cfg = mixtral_config(
+        vocab_size=257, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=96, num_experts=4,
+        num_experts_per_tok=2, max_position_embeddings=256)
+    params = init_params(jax.random.PRNGKey(13), cfg)
+    ex = BatchedStageExecutor(cfg, full_spec(cfg), params,
+                              slots=4, max_len=64)
+    prompts = {"a": [5, 9, 23, 7, 81], "b": [44, 2, 3]}
+    got = batched_generate(ex, prompts, 4)
+    for sid, prompt in prompts.items():
+        assert got[sid] == oracle_tokens(cfg, params, prompt, 4), sid
