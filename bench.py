@@ -131,6 +131,58 @@ def bench_config(name, cfg, params, *, batch, max_len, s1, s2, prefill=64,
     }
 
 
+def bench_serving_batched(cfg, params, *, slots=8, max_len=512, prefill=64,
+                          rounds=64, reps=2):
+    """The SERVING path at full slots: runtime.batching's decode_batch, one
+    jitted call per round (how a real server steps — per-step dispatch is
+    part of this path's cost structure, unlike the fused single-program
+    decode). On a tunneled chip each call pays the ~100 ms dispatch, so
+    tokens/s here is dispatch-bound; a co-located deployment pays
+    microseconds. Both the wall number and the per-round time are reported
+    so either regime can be read off."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.partition import (
+        ROLE_FULL,
+        StageSpec,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.batching import (
+        BatchedStageExecutor,
+    )
+
+    spec = StageSpec(index=0, role=ROLE_FULL, start=0, end=cfg.num_layers)
+    # ONE engine across reps: its jitted prefill/decode compile once; each
+    # rep restarts the sessions with distinct prompts.
+    ex = BatchedStageExecutor(cfg, spec, params, slots=slots,
+                              max_len=max_len, dtype=jnp.bfloat16)
+    best = float("inf")
+    for r in range(reps):
+        rng = np.random.default_rng(r)
+        toks = {}
+        for s in range(slots):
+            prompt = rng.integers(0, cfg.vocab_size, prefill, dtype=np.int32)
+            h = ex.prefill(f"s{s}", prompt[None, :])   # restarts the session
+            toks[f"s{s}"] = int(jnp.argmax(ex.logits(h[:, -1:])[0, -1]))
+        # one warm round outside the clock (first rep: decode compile)
+        out = ex.decode_batch({sid: jnp.asarray([[t]], jnp.int32)
+                               for sid, t in toks.items()})
+        np.asarray(next(iter(out.values())))
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(rounds):
+            out = ex.decode_batch({sid: jnp.asarray([[t]], jnp.int32)
+                                   for sid, t in toks.items()})
+            last = out["s0"]
+        np.asarray(last)   # hard sync on work that depends on every round
+        best = min(best, time.perf_counter() - t0)
+    per_round = best / rounds
+    return {
+        "tokens_per_s": round(slots / per_round, 2),
+        "round_ms": round(per_round * 1e3, 3),
+        "slots": slots, "max_len": max_len,
+        "note": "per-round DISPATCH included (the serving cost structure); "
+                "~100 ms/call on the tunnel, microseconds co-located",
+    }
+
+
 def main():
     import sys
 
@@ -145,9 +197,11 @@ def main():
         params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.bfloat16)
         r = bench_config("smoke", cfg, params, batch=2, max_len=128,
                          s1=8, s2=48, prefill=8, reps=2)
+        rs = bench_serving_batched(cfg, params, slots=2, max_len=64,
+                                   prefill=8, rounds=8, reps=1)
         print(json.dumps({"metric": "smoke", "value": r["tokens_per_s"],
                           "unit": "tokens/s", "vs_baseline": 1.0,
-                          "configs": {"smoke": r}}))
+                          "configs": {"smoke": r, "smoke_serving": rs}}))
         return
 
     # Step counts: the S2-S1 delta must dwarf the ±30 ms run-to-run noise of
@@ -161,6 +215,11 @@ def main():
         "gpt2_b8", gcfg, gparams, batch=8, max_len=512, s1=S1, s2=S2)
     results["gpt2_b8_s1024"] = bench_config(
         "gpt2_b8_s1024", gcfg, gparams, batch=8, max_len=1024, s1=S1, s2=S2)
+    try:
+        results["gpt2_serving_batched_8slots"] = bench_serving_batched(
+            gcfg, gparams)
+    except Exception as exc:   # the serving row must not kill the bench
+        results["gpt2_serving_batched_8slots"] = {"error": str(exc)[:200]}
     del gparams
 
     fcfg = flagship_cfg()
