@@ -43,6 +43,13 @@ Params = Dict[str, Any]
 # Inline AdamW (moment trees shard like params; no opaque optimizer state)
 # ---------------------------------------------------------------------------
 
+def ml_bfloat16():
+    import ml_dtypes
+    import numpy as np
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
 def adamw_init(params: Params) -> Params:
     zeros = lambda t: jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), t)
     return {"mu": zeros(params), "nu": zeros(params),
@@ -269,3 +276,89 @@ class PipelineTrainer:
         )
         self.last_loss = float(loss)
         return self.last_loss
+
+    # ------------------------------------------------------------------
+    # Checkpoint / resume (SURVEY.md §5.4): full training state — sharded
+    # weights + optimizer moments + step count — to one portable .npz.
+    # Restore re-places every leaf with the RUNNING trainer's shardings, so
+    # a checkpoint written on one mesh resumes on another (e.g. a larger
+    # pp×tp mesh) as long as the tree structure matches.
+    # ------------------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        import json
+
+        import numpy as np
+
+        state = {"trainables": self.trainables, "opt_state": self.opt_state}
+        flat = jax.tree_util.tree_flatten_with_path(state)[0]
+        keys, dtypes, arrays = [], [], {}
+        for i, (k, v) in enumerate(flat):
+            key = jax.tree_util.keystr(k)
+            arr = np.asarray(jax.device_get(v))
+            # Stage-stacked layer leaves ([S, L/S, ...]) are written with the
+            # stage axes MERGED to [L, ...], so a checkpoint resumes on a
+            # different pipeline depth (restore re-splits to the running
+            # trainer's [S', L/S', ...]).
+            if "layers_stacked" in key and arr.ndim >= 2:
+                arr = arr.reshape(-1, *arr.shape[2:])
+            dtypes.append(str(arr.dtype) if arr.dtype != ml_bfloat16()
+                          else "bfloat16")
+            if arr.dtype == ml_bfloat16():
+                # npz has no bf16: store the raw bits; restore view-casts
+                # back. Without this, np.load returns void bytes and the
+                # checkpoint is unrecoverable.
+                arr = arr.view(np.uint16)
+            keys.append(key)
+            arrays[f"a{i}"] = arr
+        np.savez(path, __keys__=json.dumps({"keys": keys, "dtypes": dtypes}),
+                 **arrays)
+
+    def restore(self, path: str) -> None:
+        import json
+
+        import numpy as np
+
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(str(z["__keys__"]))
+            keys, dtypes = meta["keys"], meta["dtypes"]
+            loaded = []
+            for i, dt in enumerate(dtypes):
+                arr = z[f"a{i}"]
+                if dt == "bfloat16":
+                    arr = arr.view(ml_bfloat16())
+                loaded.append(arr)
+        state = {"trainables": self.trainables, "opt_state": self.opt_state}
+        flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+        have = [jax.tree_util.keystr(k) for k, _ in flat]
+        if have != keys:
+            missing = set(keys) ^ set(have)
+            raise ValueError(
+                f"checkpoint tree does not match this trainer "
+                f"(differing leaves: {sorted(missing)[:5]}...)")
+        leaves = []
+        for (path_k, cur), arr in zip(flat, loaded):
+            key = jax.tree_util.keystr(path_k)
+            if "layers_stacked" in key and cur.ndim >= 2:
+                # Saved stage-merged [L, ...]; re-split for THIS trainer's
+                # pipeline depth.
+                if int(np.prod(arr.shape)) != int(np.prod(cur.shape)):
+                    raise ValueError(
+                        f"leaf {key}: checkpoint holds {arr.shape[0]} layers"
+                        f", trainer expects {cur.shape[0]}x{cur.shape[1]}")
+                arr = arr.reshape(cur.shape)
+            elif cur.shape != arr.shape:
+                raise ValueError(
+                    f"leaf {key}: checkpoint shape "
+                    f"{arr.shape} != trainer shape {cur.shape}")
+            sh = cur.sharding
+            if not isinstance(sh, NamedSharding):
+                # e.g. the jit-born optimizer `count` scalar: single-device
+                # and uncommitted pre-restore. device_put COMMITS, so it must
+                # be placed mesh-replicated or the next step sees
+                # incompatible devices.
+                sh = NamedSharding(self.mesh, P())
+            leaves.append(jax.device_put(jnp.asarray(arr, cur.dtype), sh))
+        state = jax.tree_util.tree_unflatten(treedef, leaves)
+        self.trainables = state["trainables"]
+        self.opt_state = state["opt_state"]

@@ -6,6 +6,8 @@ loss/grad/AdamW step is jitted over the ("stage"[, "tp"]) mesh and must match
 the unpartitioned loss + gradients exactly.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -110,3 +112,72 @@ def test_softmax_xent_ignores_masked():
     np.testing.assert_allclose(
         float(softmax_xent(logits, targets)), float(np.log(8.0)), rtol=1e-6
     )
+
+
+def test_checkpoint_resume_matches_uninterrupted(tmp_path):
+    """Save mid-training, rebuild a FRESH trainer from the same init,
+    restore, continue: the loss trajectory must equal an uninterrupted run
+    (weights + optimizer moments + step count all round-trip)."""
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    batches = [
+        (rng.integers(0, cfg.vocab_size, (2, 1, 8)).astype(np.int32),
+         rng.integers(0, cfg.vocab_size, (2, 1, 8)).astype(np.int32))
+        for _ in range(4)
+    ]
+
+    tr_a = PipelineTrainer.build(cfg, params, num_stages=2, num_micro=2,
+                                 lr=3e-3)
+    losses_a = [tr_a.step(jnp.asarray(i), jnp.asarray(t)) for i, t in batches]
+
+    tr_b = PipelineTrainer.build(cfg, params, num_stages=2, num_micro=2,
+                                 lr=3e-3)
+    for i, t in batches[:2]:
+        tr_b.step(jnp.asarray(i), jnp.asarray(t))
+    ckpt = str(tmp_path / "trainer.npz")
+    tr_b.save(ckpt)
+
+    tr_c = PipelineTrainer.build(cfg, params, num_stages=2, num_micro=2,
+                                 lr=3e-3)
+    tr_c.restore(ckpt)
+    losses_c = [tr_c.step(jnp.asarray(i), jnp.asarray(t))
+                for i, t in batches[2:]]
+    np.testing.assert_allclose(losses_c, losses_a[2:], rtol=1e-6)
+
+
+def test_checkpoint_restore_rejects_mismatched_tree(tmp_path):
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tr = PipelineTrainer.build(cfg, params, num_stages=2, num_micro=2)
+    ckpt = str(tmp_path / "t.npz")
+    tr.save(ckpt)
+    cfg2 = dataclasses.replace(cfg, num_layers=cfg.num_layers // 2)
+    params2 = init_params(jax.random.PRNGKey(0), cfg2)
+    tr2 = PipelineTrainer.build(cfg2, params2, num_stages=2, num_micro=2)
+    with pytest.raises(ValueError):
+        tr2.restore(ckpt)
+
+
+def test_checkpoint_cross_pipeline_depth_and_bf16(tmp_path):
+    """A checkpoint saved at pp=2 resumes at pp=4 (layers saved
+    stage-merged), and bf16 leaves survive the npz round trip."""
+    cfg = tiny_cfg()
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16),
+                          init_params(jax.random.PRNGKey(0), cfg))
+    tr2 = PipelineTrainer.build(cfg, params, num_stages=2, num_micro=2,
+                                lr=3e-3)
+    ids, targets = make_batch(cfg, 2, 1, 8, seed=3)
+    tr2.step(ids, targets)
+    ckpt = str(tmp_path / "pp2.npz")
+    tr2.save(ckpt)
+
+    tr4 = PipelineTrainer.build(cfg, params, num_stages=4, num_micro=2,
+                                lr=3e-3)
+    tr4.restore(ckpt)
+    # The restored pp=4 trainer holds the SAME weights: next-step losses on
+    # identical data agree closely (schedule differs, math is identical up
+    # to reduction order).
+    l2 = tr2.step(ids, targets)
+    l4 = tr4.step(ids, targets)
+    np.testing.assert_allclose(l4, l2, rtol=2e-2)
