@@ -9,6 +9,8 @@ Configs (the BASELINE.md north-star spread, sized to one chip):
   * gpt2 b8 S=1024     — same model, long-context cache bucket
   * flagship 1.1B b1   — latency-bound single-stream decode
   * flagship 1.1B b16  — throughput decode (the primary metric)
+  * batched-serving at full slots (runtime.batching; dispatch included)
+  * prefill/TTFT rows (gpt2 b8 + flagship b1 at 512 prompt tokens)
 
 Methodology (every choice is load-bearing on a tunneled chip):
   * ONE jitted lax.scan program per run (runtime.fused_decode) — the
@@ -131,6 +133,46 @@ def bench_config(name, cfg, params, *, batch, max_len, s1, s2, prefill=64,
     }
 
 
+def bench_prefill(cfg, params, *, batch, seq, n_iter=8, reps=3):
+    """Prefill (TTFT) throughput: N independent prefills of DISTINCT prompts
+    run inside ONE jitted scan, so the ~100 ms per-dispatch tunnel overhead
+    amortizes over N instead of swamping a single call. Reports prompt
+    tokens/s and the per-prefill latency (the TTFT compute floor)."""
+    max_len = seq  # prefill-only cache
+
+    @jax.jit
+    def many(params, ids_stack):
+        def body(acc, ids):
+            kc, vc = init_kv_cache(cfg, cfg.num_layers, batch, max_len,
+                                   dtype=jnp.bfloat16)
+            logits, _, _ = full_forward(cfg, params, ids, kc, vc,
+                                        jnp.int32(0))
+            tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return acc + tok, tok
+        acc, toks = jax.lax.scan(
+            body, jnp.zeros((batch,), jnp.int32), ids_stack)
+        return acc, toks
+
+    best = float("inf")
+    for r in range(reps + 1):
+        ids = jax.random.randint(jax.random.PRNGKey(300 + r),
+                                 (n_iter, batch, seq), 0, cfg.vocab_size,
+                                 jnp.int32)
+        t0 = time.perf_counter()
+        acc, toks = many(params, ids)
+        np.asarray(acc)    # depends on every prefill
+        if r > 0:          # r == 0 pays the compile
+            best = min(best, time.perf_counter() - t0)
+    per = best / n_iter
+    return {
+        "prompt_tokens_per_s": round(batch * seq / per, 1),
+        "prefill_ms": round(per * 1e3, 2),
+        "batch": batch, "seq": seq,
+        "note": "per-prefill latency = TTFT compute floor (excludes "
+                "network hops); dispatch amortized over the fused scan",
+    }
+
+
 def bench_serving_batched(cfg, params, *, slots=8, max_len=512, prefill=64,
                           rounds=64, reps=2):
     """The SERVING path at full slots: runtime.batching's decode_batch, one
@@ -199,9 +241,11 @@ def main():
                          s1=8, s2=48, prefill=8, reps=2)
         rs = bench_serving_batched(cfg, params, slots=2, max_len=64,
                                    prefill=8, rounds=8, reps=1)
+        rp = bench_prefill(cfg, params, batch=2, seq=32, n_iter=3, reps=1)
         print(json.dumps({"metric": "smoke", "value": r["tokens_per_s"],
                           "unit": "tokens/s", "vs_baseline": 1.0,
-                          "configs": {"smoke": r, "smoke_serving": rs}}))
+                          "configs": {"smoke": r, "smoke_serving": rs,
+                                      "smoke_prefill": rp}}))
         return
 
     # Step counts: the S2-S1 delta must dwarf the ±30 ms run-to-run noise of
@@ -220,6 +264,8 @@ def main():
             gcfg, gparams)
     except Exception as exc:   # the serving row must not kill the bench
         results["gpt2_serving_batched_8slots"] = {"error": str(exc)[:200]}
+    results["gpt2_prefill_b8_s512"] = bench_prefill(
+        gcfg, gparams, batch=8, seq=512)
     del gparams
 
     fcfg = flagship_cfg()
@@ -228,6 +274,8 @@ def main():
         "flagship_1b_b1", fcfg, fparams, batch=1, max_len=512, s1=S1, s2=S2)
     results["flagship_1b_b16"] = bench_config(
         "flagship_1b_b16", fcfg, fparams, batch=16, max_len=512, s1=S1, s2=S2)
+    results["flagship_prefill_b1_s512"] = bench_prefill(
+        fcfg, fparams, batch=1, seq=512, n_iter=4, reps=2)
     del fparams
 
     primary = results["flagship_1b_b16"]
