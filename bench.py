@@ -225,10 +225,58 @@ def bench_serving_batched(cfg, params, *, slots=8, max_len=512, prefill=64,
     }
 
 
+def _device_reachable(timeout_s: float = 90.0) -> bool:
+    """Probe backend init in a SUBPROCESS: a wedged axon tunnel hangs
+    jax.devices() indefinitely, which would turn the driver's bench run
+    into a silent timeout instead of a parseable result line."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    import os
+    import subprocess
     import sys
 
     results = {}
+
+    if "--smoke" not in sys.argv and not _device_reachable():
+        # Device backend unreachable (tunnel down): emit a parseable line
+        # with the failure named, plus a CPU structural smoke so the run
+        # still proves the harness executes end to end.
+        smoke = None
+        try:
+            env = dict(os.environ, PALLAS_AXON_POOL_IPS="",
+                       JAX_PLATFORMS="cpu")
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--smoke"],
+                timeout=900, env=env, capture_output=True, text=True)
+            for line in reversed(out.stdout.strip().splitlines()):
+                try:
+                    smoke = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+        except Exception:
+            pass
+        print(json.dumps({
+            "metric": "flagship_1b_b16_decode_throughput",
+            "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+            "error": "device backend unreachable (axon tunnel down); "
+                     "no TPU measurement possible this run",
+            "cpu_structural_smoke": smoke,
+        }))
+        return
 
     if "--smoke" in sys.argv:
         # Structural validation on whatever backend is available (CPU-safe):
@@ -287,8 +335,12 @@ def main():
             with open(path) as f:
                 rec = json.load(f)
             parsed = rec.get("parsed", rec)
-            if parsed.get("unit") == "tokens/s":
-                if parsed.get("metric") == "flagship_1b_b16_decode_throughput":
+            if parsed.get("unit") == "tokens/s" and not parsed.get("error"):
+                if (parsed.get("metric") == "flagship_1b_b16_decode_throughput"
+                        and parsed.get("value")):
+                    # error/zero records (tunnel-down fallback) must not
+                    # become the baseline, or the next real run reports a
+                    # meaningless vs_baseline=1.0.
                     prev = parsed.get("value")
         except Exception:
             pass
