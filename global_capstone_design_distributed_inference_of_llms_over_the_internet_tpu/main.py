@@ -245,29 +245,39 @@ def run_fused(args, cfg: ModelConfig, params) -> int:
 def run_oracle(args, cfg: ModelConfig, params) -> int:
     """Single-device unpartitioned generation (scripts/single_gpu_check.py).
 
-    Greedy (temperature<=0) rides the fused multi-step engine
-    (runtime.fused_decode): whole chunks of decode run as ONE compiled
-    program with stop conditions checked between chunks — the CUDA-graph
-    replay the reference's oracle lacks. Sampled decoding keeps the
-    per-token loop (the sampler needs host-visible logits each step)."""
-    from .ops.sampling import RECENT_WINDOW, sample_token
+    Both greedy and sampled decoding ride the fused multi-step engine
+    (runtime.fused_decode): whole chunks run as ONE compiled program with
+    stop conditions checked between chunks — the CUDA-graph replay the
+    reference's oracle lacks. The sampled path folds the full reference
+    sampler into the scan with the SAME per-step key schedule as the old
+    per-token loop, so outputs are bit-identical to it."""
 
-    def generate_greedy(prompt_ids, max_new_tokens, sampling,
-                        eos_token_id=None, **_kw):
+    def _drive_chunks(prompt_ids, max_new_tokens, eos_token_id, *,
+                      prefill_first_token, run_chunk, chunk):
+        """Shared chunked-generation driver for both fused engines.
+
+        ``prefill_first_token(ids, kc, vc) -> (tok0, kc, vc)`` consumes the
+        prompt and produces the first token (greedy argmax or key-schedule
+        step 0 of the sampler); ``run_chunk(last_tok, cur, n, kc, vc, step)
+        -> (got_tokens, kc, vc)`` runs n fused steps (``step`` = PRNG
+        schedule index of the chunk's first token; the greedy engine ignores
+        it). Stop conditions are re-checked PER TOKEN inside each chunk —
+        the fused program may overshoot an EOS/repeat point and the trimmed
+        output must match per-token decoding exactly — and each chunk's
+        FULL wall time amortizes over the KEPT tokens so reported tokens/s
+        doesn't inflate on overshoot."""
         from .runtime.client import GenerationResult
-        from .runtime.fused_decode import make_fused_decode
 
-        chunk = min(max_new_tokens, 32)
         max_len = max(128, len(prompt_ids) + max_new_tokens + 1)
         kc, vc = init_kv_cache(cfg, cfg.num_layers, 1, max_len,
                                dtype=params["embed"]["wte"].dtype)
         ids = jnp.asarray(np.asarray(prompt_ids, np.int32)[None, :])
         t0 = time.monotonic()
-        logits, kc, vc = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
-        tokens = [int(jnp.argmax(logits[0, -1]))]
+        tok0, kc, vc = prefill_first_token(ids, kc, vc)
+        tokens = [int(tok0)]
         ttft = time.monotonic() - t0
-        fn = make_fused_decode(cfg, chunk, 1, exact_head=True)
         cur = len(prompt_ids)
+        step = 1                      # PRNG schedule index: seed + step
         decode_times: List[float] = []
         stopped = "max_tokens"
         while len(tokens) < max_new_tokens and stopped == "max_tokens":
@@ -279,26 +289,20 @@ def run_oracle(args, cfg: ModelConfig, params) -> int:
                 break
             n = min(chunk, max_new_tokens - len(tokens))
             t0 = time.monotonic()
-            toks, kc, vc = fn(params, jnp.asarray([tokens[-1]], jnp.int32),
-                              kc, vc, jnp.int32(cur), jnp.int32(n))
-            got = [int(t) for t in np.asarray(toks[:n, 0])]
+            got, kc, vc = run_chunk(tokens[-1], cur, n, kc, vc, step)
             dt = time.monotonic() - t0
-            # Stop conditions re-checked PER TOKEN inside the chunk: the
-            # fused program may overshoot an EOS/repeat point; trim so the
-            # output matches the per-token loop exactly up to the stop.
             kept = 0
             for tok in got:
-                tokens.append(tok)
+                tokens.append(int(tok))
                 cur += 1
+                step += 1
                 kept += 1
-                if eos_token_id is not None and tok == eos_token_id:
+                if eos_token_id is not None and int(tok) == eos_token_id:
                     stopped = "eos"
                     break
                 if len(tokens) >= 5 and len(set(tokens[-5:])) == 1:
                     stopped = "repeat"
                     break
-            # The chunk's FULL wall time amortizes over the KEPT tokens, so
-            # the reported tokens/s doesn't inflate when a stop overshoots.
             decode_times.extend([dt / max(kept, 1)] * kept)
         return GenerationResult(
             tokens=tokens[:max_new_tokens], ttft_s=ttft,
@@ -307,54 +311,58 @@ def run_oracle(args, cfg: ModelConfig, params) -> int:
 
     def generate(prompt_ids, max_new_tokens, sampling, eos_token_id=None,
                  **_kw):
-        from .runtime.client import GenerationResult
-
+        chunk = min(max_new_tokens, 32)
         if sampling.greedy:
-            return generate_greedy(prompt_ids, max_new_tokens, sampling,
-                                   eos_token_id=eos_token_id, **_kw)
-        max_len = len(prompt_ids) + max_new_tokens + 1
-        kc, vc = init_kv_cache(cfg, cfg.num_layers, 1, max(128, max_len),
-                               dtype=params["embed"]["wte"].dtype)
-        ids = jnp.asarray(np.asarray(prompt_ids, np.int32)[None, :])
-        tokens: List[int] = []
+            from .runtime.fused_decode import make_fused_decode
 
-        def pick(last_logits, step):
-            recent = np.zeros((RECENT_WINDOW,), np.int32)
-            n = min(len(tokens), RECENT_WINDOW)
-            if n:
-                recent[:n] = np.asarray(tokens[-n:], np.int32)
-            return int(sample_token(
-                jax.random.PRNGKey(args.seed + step), last_logits,
-                jnp.asarray(recent), jnp.asarray(n, jnp.int32),
-                jnp.asarray(sampling.temperature, jnp.float32),
-                jnp.asarray(sampling.top_p, jnp.float32),
-                jnp.asarray(sampling.top_k, jnp.int32),
-                jnp.asarray(sampling.repetition_penalty, jnp.float32),
-            ))
+            fn = make_fused_decode(cfg, chunk, 1, exact_head=True)
 
-        t0 = time.monotonic()
-        logits, kc, vc = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
-        tokens.append(pick(logits[0, -1], 0))
-        ttft = time.monotonic() - t0
-        cur = len(prompt_ids)
-        decode_times = []
-        stopped = "max_tokens"
-        for step in range(1, max_new_tokens):
-            if eos_token_id is not None and tokens[-1] == eos_token_id:
-                stopped = "eos"
-                break
-            if len(tokens) >= 5 and len(set(tokens[-5:])) == 1:
-                stopped = "repeat"
-                break
-            t0 = time.monotonic()
-            nxt = jnp.asarray([[tokens[-1]]], jnp.int32)
-            logits, kc, vc = full_forward(cfg, params, nxt, kc, vc,
-                                          jnp.int32(cur))
-            tokens.append(pick(logits[0, 0], step))
-            decode_times.append(time.monotonic() - t0)
-            cur += 1
-        return GenerationResult(tokens=tokens, ttft_s=ttft,
-                                decode_times_s=decode_times, stopped_by=stopped)
+            def prefill_first(ids, kc, vc):
+                logits, kc, vc = full_forward(cfg, params, ids, kc, vc,
+                                              jnp.int32(0))
+                return int(jnp.argmax(logits[0, -1])), kc, vc
+
+            def run_chunk(last, cur, n, kc, vc, step):
+                toks, kc, vc = fn(params, jnp.asarray([last], jnp.int32),
+                                  kc, vc, jnp.int32(cur), jnp.int32(n))
+                return [int(t) for t in np.asarray(toks[:n, 0])], kc, vc
+
+            return _drive_chunks(prompt_ids, max_new_tokens, eos_token_id,
+                                 prefill_first_token=prefill_first,
+                                 run_chunk=run_chunk, chunk=chunk)
+
+        from .ops.sampling import make_recent_buffer, push_recent, sample_token
+        from .runtime.fused_decode import make_fused_sample_decode
+
+        fn = make_fused_sample_decode(cfg, chunk)
+        sp_args = (jnp.asarray(sampling.temperature, jnp.float32),
+                   jnp.asarray(sampling.top_p, jnp.float32),
+                   jnp.asarray(sampling.top_k, jnp.int32),
+                   jnp.asarray(sampling.repetition_penalty, jnp.float32))
+        state = {"recent": None, "nvalid": None}
+
+        def prefill_first(ids, kc, vc):
+            logits, kc, vc = full_forward(cfg, params, ids, kc, vc,
+                                          jnp.int32(0))
+            recent, nvalid = make_recent_buffer()
+            # First token: key schedule step 0 (same as the per-token loop).
+            tok = sample_token(jax.random.PRNGKey(args.seed), logits[0, -1],
+                               recent, nvalid, *sp_args)
+            state["recent"], state["nvalid"] = push_recent(recent, nvalid,
+                                                           tok)
+            return int(tok), kc, vc
+
+        def run_chunk(last, cur, n, kc, vc, step):
+            toks, kc, vc, state["recent"], state["nvalid"] = fn(
+                params, jnp.asarray(last, jnp.int32), kc, vc,
+                jnp.int32(cur), jnp.int32(n),
+                jnp.int32(args.seed + step), state["recent"],
+                state["nvalid"], *sp_args)
+            return [int(t) for t in np.asarray(toks[:n])], kc, vc
+
+        return _drive_chunks(prompt_ids, max_new_tokens, eos_token_id,
+                             prefill_first_token=prefill_first,
+                             run_chunk=run_chunk, chunk=chunk)
 
     return _generate_and_report(args, generate, cfg,
                                 supports_speculative=False)

@@ -22,9 +22,11 @@ Two measured structural choices (slope-timed on a v5e, gpt2-124M b8 and a
     reduction — measured ~1.5x over the fp32-matmul + row-major argmax
     pair at gpt2's vocab.
 
-Greedy-only by design: this is the throughput engine the bench measures
-and the oracle fast path; sampled serving rides the per-session/batched
-executors whose per-step sampler needs host-visible logits anyway.
+`make_fused_decode` is the greedy throughput engine (bench + oracle fast
+path); `make_fused_sample_decode` folds the FULL reference sampler into
+the scan for batch-1 sampled generation, bit-identical to the per-token
+oracle loop. Distributed serving still samples per step on the final hop
+(the sampler needs the request's live metadata there).
 """
 
 from __future__ import annotations
@@ -36,9 +38,24 @@ import jax
 import jax.numpy as jnp
 
 from ..models.config import ModelConfig
-from ..models.transformer import _norm, stack_forward
+from ..models.transformer import _norm, lm_head, stack_forward
+from ..ops.sampling import RECENT_WINDOW, push_recent, sample_token
 
 Params = Dict[str, Any]
+
+
+def _decode_step(cfg: ModelConfig, params: Params, tok: jnp.ndarray,
+                 kc: jnp.ndarray, vc: jnp.ndarray, cl: jnp.ndarray):
+    """ONE decode step shared by the greedy and sampled fused engines:
+    embed (+ learned positions), cache-carrying stack_forward (T == 1 fast
+    path). tok: [B] int32 -> (h [B, T=1, D], kc, vc)."""
+    batch = tok.shape[0]
+    pos = cl + jnp.zeros((batch, 1), jnp.int32)
+    x = jnp.take(params["embed"]["wte"], tok[:, None], axis=0)
+    if cfg.positional == "learned":
+        p = jnp.clip(pos, 0, cfg.max_position_embeddings - 1)
+        x = x + jnp.take(params["embed"]["wpe"], p, axis=0)
+    return stack_forward(cfg, params["layers"], x, pos, kc, vc, cl)
 
 
 def make_fused_decode(cfg: ModelConfig, max_steps: int, batch: int,
@@ -83,16 +100,7 @@ def make_fused_decode(cfg: ModelConfig, max_steps: int, batch: int,
 
         def body(i, carry):
             tok, kc, vc, cl, toks = carry
-            pos = cl + jnp.zeros((batch, 1), jnp.int32)
-            x = jnp.take(params["embed"]["wte"], tok[:, None], axis=0)
-            if cfg.positional == "learned":
-                p = jnp.clip(pos, 0, cfg.max_position_embeddings - 1)
-                x = x + jnp.take(params["embed"]["wpe"], p, axis=0)
-            # T == 1, so stack_forward takes its cache-carrying decode fast
-            # path (ONE shared implementation of the per-layer in-place
-            # update — models/transformer.py).
-            h, kc, vc = stack_forward(cfg, params["layers"], x, pos, kc, vc,
-                                      cl)
+            h, kc, vc = _decode_step(cfg, params, tok, kc, vc, cl)
             h = _norm(cfg, params["final_norm"], h)[:, 0]
             tok = head_argmax(params, h)
             toks = jax.lax.dynamic_update_index_in_dim(toks, tok, i, 0)
@@ -101,5 +109,45 @@ def make_fused_decode(cfg: ModelConfig, max_steps: int, batch: int,
         tok, kc, vc, _, toks = jax.lax.fori_loop(
             0, n, body, (tok, kc, vc, start, toks0))
         return toks, kc, vc
+
+    return fn
+
+
+def make_fused_sample_decode(cfg: ModelConfig, max_steps: int):
+    """Fused multi-step SAMPLED decode (batch 1): the full reference sampler
+    — count-scaled repetition penalty over the recent-50 window, triple-
+    repeat guard, temperature, top-k, top-p (ops.sampling) — folded into the
+    step scan, with the window carried as a ring buffer.
+
+    The per-step key is ``PRNGKey(seed0 + i)`` (PRNGKey is traceable), the
+    EXACT schedule of the per-token oracle loop (main.run_oracle /
+    tests' oracle_generate) — so output is bit-identical to per-token
+    sampled decoding while running as ONE compiled program.
+
+    Returns ``fn(params, tok, kc, vc, start, n, seed0, recent, nvalid,
+    temperature, top_p, top_k, repetition_penalty) ->
+    (toks, kc, vc, recent, nvalid)`` — recent/nvalid thread across chunked
+    calls so stop-condition checks between chunks don't reset the window.
+    """
+
+    @partial(jax.jit, donate_argnums=(2, 3))
+    def fn(params, tok, kc, vc, start, n, seed0, recent, nvalid,
+           temperature, top_p, top_k, repetition_penalty):
+        toks0 = jnp.zeros((max_steps,), jnp.int32)
+
+        def body(i, carry):
+            tok, kc, vc, cl, recent, nvalid, toks = carry
+            h, kc, vc = _decode_step(cfg, params, tok[None], kc, vc, cl)
+            logits = lm_head(cfg, params, h)[0, 0]  # applies final_norm
+            tok = sample_token(
+                jax.random.PRNGKey(seed0 + i), logits, recent, nvalid,
+                temperature, top_p, top_k, repetition_penalty)
+            recent, nvalid = push_recent(recent, nvalid, tok)
+            toks = jax.lax.dynamic_update_index_in_dim(toks, tok, i, 0)
+            return (tok, kc, vc, cl + 1, recent, nvalid, toks)
+
+        tok, kc, vc, _, recent, nvalid, toks = jax.lax.fori_loop(
+            0, n, body, (tok, kc, vc, start, recent, nvalid, toks0))
+        return toks, kc, vc, recent, nvalid
 
     return fn

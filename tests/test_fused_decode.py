@@ -70,3 +70,60 @@ def test_fused_decode_quantized_runs():
     toks, _, _ = fn(params, jnp.zeros((2,), jnp.int32), kc, vc,
                     jnp.int32(1), jnp.int32(3))
     assert np.asarray(toks).shape == (3, 2)
+
+
+def test_fused_sampled_decode_matches_per_token_oracle():
+    """make_fused_sample_decode folds the FULL sampler into the scan with
+    the per-token oracle's exact key schedule (PRNGKey(seed+step)) — output
+    must be bit-identical to stepping full_forward + sample_token by
+    hand."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.ops.sampling import (
+        RECENT_WINDOW,
+        make_recent_buffer,
+        push_recent,
+        sample_token,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.fused_decode import (
+        make_fused_sample_decode,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(4), cfg)
+    prompt = [5, 9, 23, 7]
+    seed, steps = 77, 9
+    sp = (jnp.asarray(0.9, jnp.float32), jnp.asarray(0.95, jnp.float32),
+          jnp.asarray(40, jnp.int32), jnp.asarray(1.4, jnp.float32))
+
+    # per-token oracle
+    kc, vc = init_kv_cache(cfg, cfg.num_layers, 1, 32)
+    ids = jnp.asarray(np.asarray(prompt, np.int32)[None, :])
+    logits, kc, vc = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
+    want = []
+    for step in range(steps):
+        recent = np.zeros((RECENT_WINDOW,), np.int32)
+        n = min(len(want), RECENT_WINDOW)
+        if n:
+            recent[:n] = np.asarray(want[-n:], np.int32)
+        src = logits[0, -1] if step == 0 else logits[0, 0]
+        tok = int(sample_token(jax.random.PRNGKey(seed + step), src,
+                               jnp.asarray(recent), jnp.asarray(n, jnp.int32),
+                               *sp))
+        want.append(tok)
+        if step < steps - 1:
+            logits, kc, vc = full_forward(
+                cfg, params, jnp.asarray([[tok]], jnp.int32), kc, vc,
+                jnp.int32(len(prompt) + step))
+
+    # fused: first token by hand (schedule step 0), rest in ONE program
+    kc, vc = init_kv_cache(cfg, cfg.num_layers, 1, 32)
+    logits, kc, vc = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
+    recent, nvalid = make_recent_buffer()
+    tok0 = sample_token(jax.random.PRNGKey(seed), logits[0, -1], recent,
+                        nvalid, *sp)
+    recent, nvalid = push_recent(recent, nvalid, tok0)
+    fn = make_fused_sample_decode(cfg, steps - 1)
+    toks, kc, vc, recent, nvalid = fn(
+        params, tok0, kc, vc, jnp.int32(len(prompt)), jnp.int32(steps - 1),
+        jnp.int32(seed + 1), recent, nvalid, *sp)
+    got = [int(tok0)] + [int(t) for t in np.asarray(toks[: steps - 1])]
+    assert got == want
