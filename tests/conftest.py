@@ -56,6 +56,7 @@ _SMOKE = (
     "test_partition.py",
     "test_task_pool.py",
     "test_throughput.py",
+    "test_chunked_wire.py",
     # curated representatives of the heavier engines
     "test_runtime_pipeline.py::test_pipeline_greedy_matches_oracle",
     "test_runtime_pipeline.py::test_failover_mid_generation_preserves_tokens",
