@@ -429,3 +429,54 @@ def test_stream_session_deadline_enforced(swarm):
         transport.call(hop.peer_id, StageRequest(
             session_id="dl", hidden=h1, seq_len=1, cur_len=3,
             is_prefill=False, max_length=16))
+
+
+def test_stream_per_step_timeout_enforced_via_runtime():
+    """A stream opened with a tiny step_timeout gets a retryable stage error
+    from the runtime's deadline instead of hanging — the server-side
+    per-step budget of petals handler.py:132-195."""
+    import jax.numpy as jnp
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.executor import (
+        StageExecutionError,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.messages import (
+        StageRequest,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.task_pool import (
+        StageRuntime,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plan = StagePlan.from_splits(cfg.num_layers, [4])
+    reg = RegistryServer()
+    reg.start()
+    ex = StageExecutor(cfg, plan.stages[1],
+                       slice_stage_params(cfg, params, plan.stages[1]),
+                       peer_id="to-srv")
+    srv = TcpStageServer(ex, wire_dtype="f32", runtime=StageRuntime())
+    srv.start()
+    rec = make_server_record("to-srv", plan.stages[1])
+    rec.address = srv.address
+    reg.registry.register(rec)
+    try:
+        registry = RemoteRegistry(reg.address)
+        h = jnp.zeros((1, 3, cfg.hidden_size), jnp.float32)
+        # Sanity: a NORMAL stream step works on this server first.
+        ok_tx = TcpTransport(registry, wire_dtype="f32")
+        ok_tx.call("to-srv", StageRequest(
+            session_id="ok", hidden=h, seq_len=3, cur_len=0,
+            is_prefill=True, max_length=16))
+        ok_tx.close()
+        # step_timeout so small the first (compiling) step can't make it.
+        to_tx = TcpTransport(registry, wire_dtype="f32",
+                             step_timeout=0.005)
+        with pytest.raises(StageExecutionError, match="timed out"):
+            to_tx.call("to-srv", StageRequest(
+                session_id="slow", hidden=h, seq_len=3, cur_len=0,
+                is_prefill=True, max_length=16), timeout=30.0)
+        to_tx.close()
+    finally:
+        srv.stop()
+        reg.stop()
