@@ -473,7 +473,7 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
     logger.info("warming up stage %d (pre-compiling step shapes)", args.stage)
     ex.warmup()
     srv = TcpStageServer(ex, host=args.host, port=args.rpc_port,
-                         wire_dtype=args.wire_dtype)
+                         wire_dtype=args.wire_dtype, model=_model_id(args))
     srv.start()
     # --public_ip overrides the advertised address (the reference's
     # public-maddr-only advertising, component 21 / src/main.py:492-509).
@@ -540,7 +540,8 @@ def _run_serve_elastic(args, cfg: ModelConfig, params) -> int:
     peer = args.peer_id or f"lb-{os.getpid()}"
     registry = RemoteRegistry(args.registry_addr)
     srv = TcpStageServer(None, host=args.host, port=args.rpc_port,
-                         wire_dtype=args.wire_dtype, peer_id=peer)
+                         wire_dtype=args.wire_dtype, peer_id=peer,
+                         model=_model_id(args))
     srv.start()
     advert = (f"{args.public_ip}:{srv.address.rsplit(':', 1)[1]}"
               if args.public_ip else srv.address)
@@ -612,7 +613,8 @@ def run_client(args, cfg: ModelConfig, params) -> int:
     plan = (StagePlan.from_splits(cfg.num_layers, splits) if splits
             else StagePlan.even(cfg.num_layers, 4))
     registry = RemoteRegistry(args.registry_addr)
-    transport = TcpTransport(registry, wire_dtype=args.wire_dtype)
+    transport = TcpTransport(registry, wire_dtype=args.wire_dtype,
+                             model=_model_id(args))
     stage0 = _SE(cfg, plan.stages[0],
                  _stage_params(args, cfg, params, plan.stages[0]),
                  peer_id="client-local")

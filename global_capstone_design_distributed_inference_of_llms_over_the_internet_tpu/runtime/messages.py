@@ -76,6 +76,11 @@ class StageRequest:
     # argmax(logits[i])), rewinds its own KV past the rejected tail, and
     # returns the accepted tokens plus one correction/bonus token.
     draft_tokens: Optional[Tuple[int, ...]] = None
+    # Model identity as declared by the ORIGINATING client (the data-plane
+    # mirror of the reference's model-prefixed DHT keys). Servers reject
+    # mismatches and relays propagate the original tag — an untagged legacy
+    # hop must not strip the client's tag from the rest of the chain.
+    model: Optional[str] = None
     # Push-chain route (the ``next_servers`` metadata of Petals'
     # server→server push, ``petals/server/handler.py:320-350``): the hops
     # AFTER this one. A server that produced hidden output forwards it

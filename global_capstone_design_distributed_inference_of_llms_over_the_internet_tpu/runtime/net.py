@@ -62,6 +62,13 @@ MAX_FRAME = 1 << 30
 # early corruption detection, and no hard 1 GiB payload ceiling.
 CHUNK_SIZE = 64 * 1024 * 1024
 MAX_PAYLOAD = 8 << 30          # 8 GiB sanity cap on a chunked payload
+# CRC-valid bytes a chunked sender must commit before the receiver trusts
+# the header-declared total enough to preallocate the full buffer. The
+# effective threshold scales with the declared total (see _recv_frame), so a
+# hostile sender's memory amplification is bounded by PREALLOC_AMP regardless
+# of how large a total it declares.
+PREALLOC_COMMIT = 128 * 1024 * 1024
+PREALLOC_AMP = 8
 
 
 class WireError(ConnectionError):
@@ -110,6 +117,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
+    # Returns bytes for ordinary frames; a reassembled chunked payload may be
+    # a bytearray (bytes-like) to avoid a multi-GiB defensive copy.
     magic = _recv_exact(sock, 4)
     if magic != MAGIC:
         raise WireError(f"bad magic {magic!r}")
@@ -136,10 +145,15 @@ def _recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
         total = int(ch["total"])
         if not 0 <= total <= MAX_PAYLOAD:
             raise WireError(f"oversized chunked payload {total}")
-        # Grow the buffer as data ARRIVES — preallocating the header-declared
-        # total would let a hostile 100-byte frame force a MAX_PAYLOAD-sized
-        # allocation before committing a single chunk byte (remote OOM).
-        buf = bytearray()
+        # Preallocating the header-declared total up front would let a
+        # hostile 100-byte frame force a MAX_PAYLOAD-sized allocation before
+        # committing a single chunk byte (remote OOM), so the full buffer is
+        # only allocated once the sender has committed PREALLOC_COMMIT bytes
+        # of CRC-valid data; until then chunks accumulate in a list. Writing
+        # the tail in place (no trailing bytes(buf) copy) keeps peak memory
+        # at ~total instead of ~2x total for multi-GiB payloads.
+        chunks: list = []
+        buf: Optional[bytearray] = None
         off = 0
         while off < total:
             (clen,) = struct.unpack("<I", _recv_exact(sock, 4))
@@ -149,9 +163,28 @@ def _recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
             (ccrc,) = struct.unpack("<I", _recv_exact(sock, 4))
             if ccrc != native.crc32c(chunk):
                 raise WireError(f"chunk checksum mismatch at offset {off}")
-            buf += chunk
+            if buf is not None:
+                buf[off:off + clen] = chunk
+            else:
+                chunks.append(chunk)
+                if off + clen >= min(total, max(PREALLOC_COMMIT,
+                                                total // PREALLOC_AMP)):
+                    buf = bytearray(total)
+                    pos = 0
+                    for c in chunks:
+                        buf[pos:pos + len(c)] = c
+                        pos += len(c)
+                    chunks = []
             off += clen
-        payload = bytes(buf)
+        # No trailing copy of the preallocated buffer: every consumer
+        # (np.frombuffer, socket.sendall, slicing in _decode_tensors) takes
+        # any bytes-like object, and bytes(buf) would briefly double memory
+        # at the exact payload sizes this path exists to support.
+        payload = b"".join(chunks) if buf is None else buf
+        # The reassembled payload replaces the (empty) chunked one — drop the
+        # descriptor so a relayed re-send of this header re-derives framing
+        # from the actual payload size instead of replaying a stale one.
+        header.pop("chunked", None)
     return header, payload
 
 
@@ -196,8 +229,9 @@ def _decode_tensors(metas: list, payload: bytes) -> list:
     return out
 
 
-def _request_header(req: StageRequest, tensor_meta: dict) -> dict:
-    return {
+def _request_header(req: StageRequest, tensor_meta: dict,
+                    model: Optional[str] = None) -> dict:
+    hdr = {
         "verb": "forward",
         "session_id": req.session_id,
         "seq_len": req.seq_len,
@@ -221,6 +255,12 @@ def _request_header(req: StageRequest, tensor_meta: dict) -> dict:
                          else list(req.draft_tokens)),
         "tensor": tensor_meta,
     }
+    # Model identity echo: the data-plane counterpart of the reference's
+    # model-prefixed DHT keys (src/dht_utils.py:20-31). A mis-routed request
+    # (wrong model's server) must fail loudly, not produce garbage activations.
+    if model is not None:
+        hdr["model"] = model
+    return hdr
 
 
 def _header_to_request(h: dict, payload: bytes) -> StageRequest:
@@ -248,6 +288,7 @@ def _header_to_request(h: dict, payload: bytes) -> StageRequest:
         start_from_position=h.get("start_from_position"),
         draft_tokens=(None if h.get("draft_tokens") is None
                       else tuple(h["draft_tokens"])),
+        model=h.get("model"),
     )
 
 
@@ -357,7 +398,8 @@ class TcpStageServer(_FramedTcpServer):
                  runtime: Optional["StageRuntime"] = None,
                  compute_timeout: float = 120.0,
                  owns_runtime: bool = True,
-                 peer_id: Optional[str] = None):
+                 peer_id: Optional[str] = None,
+                 model: Optional[str] = None):
         # May be swapped at runtime (elastic servers re-span in place) or
         # None during a re-span window — requests then get a retryable
         # stage error and clients fail over / retry.
@@ -366,6 +408,12 @@ class TcpStageServer(_FramedTcpServer):
         # frames must carry a real peer id even mid-re-span, or push-chain
         # clients blacklist a placeholder and never route around us.
         self.peer_id = peer_id or (executor.peer_id if executor else None)
+        # Which model this server's weights belong to. Tagged requests from a
+        # different model are rejected before touching the executor — the
+        # data-plane enforcement of the registry's model scoping (ADVICE r2:
+        # _model_ok alone cannot stop a mis-constructed client from shipping
+        # model-A activations into model-B blocks).
+        self.model = model
         self.wire_dtype = wire_dtype
         self.runtime = runtime
         self.compute_timeout = compute_timeout
@@ -414,7 +462,15 @@ class TcpStageServer(_FramedTcpServer):
                 # the same next hop must not interleave frames on one socket.
                 with lock:
                     sock.settimeout(timeout)
-                    _send_frame(sock, _request_header(nreq, meta), body)
+                    # Propagate the ORIGINATING client's tag when it has one
+                    # — an untagged legacy hop relaying with only self.model
+                    # (None) would strip the tag from the rest of the chain.
+                    _send_frame(sock,
+                                _request_header(
+                                    nreq, meta,
+                                    model=(nreq.model if nreq.model is not None
+                                           else self.model)),
+                                body)
                     return _recv_frame(sock)
             except (ConnectionError, OSError):
                 self._drop_relay(addr, sock)
@@ -500,6 +556,18 @@ class TcpStageServer(_FramedTcpServer):
                                "peer": self.peer_id or "?",
                                "message": "server is re-spanning"})
             return
+        req_model = header.get("model")
+        if (req_model is not None and self.model is not None
+                and req_model != self.model):
+            # kind="stage" puts this in the client's retryable taxonomy: it
+            # blacklists this peer and re-discovers (correctly) scoped peers.
+            _send_frame(sock, {"verb": "error", "kind": "stage",
+                               "peer": self.peer_id or "?",
+                               "model_mismatch": True,
+                               "message": f"model mismatch: request is for "
+                                          f"{req_model!r}, server holds "
+                                          f"{self.model!r}"})
+            return
         if verb == "stream_open":
             self._stream_open(sock, header)
             return
@@ -511,6 +579,11 @@ class TcpStageServer(_FramedTcpServer):
         elif verb in ("train_forward", "backward"):
             self._train_verbs(sock, ex, verb, header, payload)
         elif verb == "end_session":
+            # Drop the session's stream state too, or metadata + the 50-token
+            # window would accumulate per ended session on long-lived client
+            # connections until the socket closes.
+            with self._streams_lock:
+                self._streams.get(sock, {}).pop(header["session_id"], None)
             # Through the runtime's compute thread, NOT inline: freeing the
             # arena handle while a timed-out forward for the same session is
             # still stepping its KV buffers would null them mid-step and
@@ -561,6 +634,7 @@ class TcpStageServer(_FramedTcpServer):
             ),
             "start_block": header.get("start_block"),
             "end_block": header.get("end_block"),
+            "model": header.get("model"),
             "next_servers": tuple(header.get("next_servers", ())),
             # Server-maintained recent-token window: seeded here, then
             # appended with every token THIS server samples for the session
@@ -617,6 +691,7 @@ class TcpStageServer(_FramedTcpServer):
             step_seed=header.get("step_seed", 0),
             start_block=state["start_block"],
             end_block=state["end_block"],
+            model=state["model"],
             next_servers=state["next_servers"],
             start_from_position=header.get("start_from_position"),
         )
@@ -814,8 +889,12 @@ class TcpTransport(Transport):
     def __init__(self, registry, wire_dtype: str = "bf16",
                  connect_timeout: float = 5.0, use_streams: bool = True,
                  step_timeout: Optional[float] = None,
-                 session_deadline_s: Optional[float] = None):
+                 session_deadline_s: Optional[float] = None,
+                 model: Optional[str] = None):
         self.registry = registry
+        # Echoed in every request so a mis-routed peer (different model)
+        # rejects instead of computing garbage; None = untagged legacy client.
+        self.model = model
         self.wire_dtype = wire_dtype
         self.connect_timeout = connect_timeout
         # Persistent per-session streams (metadata once, deltas per step).
@@ -829,6 +908,15 @@ class TcpTransport(Transport):
         # (peer_id, session_id) -> {"snap", "sock", "window", "returns_tokens"}
         self._streams: Dict[Tuple[str, str], dict] = {}
         self._lock = threading.Lock()
+
+    def _tagged(self, hdr: dict) -> dict:
+        """Stamp the client's model identity on an outgoing request header.
+        EVERY request-frame builder must route through this (or pass
+        model= to _request_header) so the 'tagged requests fail loudly on
+        mis-routed peers' invariant is structural, not per-call-site."""
+        if self.model is not None:
+            hdr["model"] = self.model
+        return hdr
 
     def _addr(self, peer_id: str) -> Tuple[str, int]:
         rec = self.registry.get(peer_id)
@@ -917,11 +1005,12 @@ class TcpTransport(Transport):
                     "end_block": request.end_block,
                     "tensors": metas,
                 }
-                _send_frame(sock, hdr, body)
+                _send_frame(sock, self._tagged(hdr), body)
             else:
                 arr = np.asarray(request.hidden)
                 meta, body = _encode_tensor(arr, self.wire_dtype)
-                _send_frame(sock, _request_header(request, meta), body)
+                _send_frame(sock, self._tagged(_request_header(request, meta)),
+                            body)
             header, payload = _recv_frame(sock)
         except socket.timeout as exc:
             self._drop(peer_id)
@@ -953,7 +1042,7 @@ class TcpTransport(Transport):
                 st = self._streams.get(key)
                 stale = st is None or st["snap"] != snap or st["sock"] is not sock
             if stale:
-                _send_frame(sock, {
+                open_hdr = {
                     "verb": "stream_open",
                     "session_id": request.session_id,
                     "max_length": request.max_length,
@@ -967,7 +1056,8 @@ class TcpTransport(Transport):
                     "next_servers": list(request.next_servers),
                     "step_timeout": self.step_timeout,
                     "deadline_s": self.session_deadline_s,
-                })
+                }
+                _send_frame(sock, self._tagged(open_hdr))
                 h, _ = _recv_frame(sock)
                 if h.get("verb") != "ok":
                     self._parse_response(peer_id, h, b"")  # raises
@@ -1095,7 +1185,7 @@ class TcpTransport(Transport):
                 "end_block": request.end_block,
                 "tensors": metas,
             }
-            _send_frame(sock, hdr, body)
+            _send_frame(sock, self._tagged(hdr), body)
             header, payload = _recv_frame(sock)
         except socket.timeout as exc:
             self._drop(peer_id)

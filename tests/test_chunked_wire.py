@@ -51,7 +51,22 @@ def test_oversized_payload_chunks_and_roundtrips(monkeypatch):
     payload = rng.integers(0, 256, 3_500_000, dtype=np.uint8).tobytes()  # 3.3 MiB
     h, p = _roundtrip({"verb": "hidden", "tensor": {"shape": [1]}}, payload)
     assert p == payload
-    assert h["chunked"]["total"] == len(payload)
+    # The stale descriptor must NOT survive reassembly: _relay re-sends
+    # relayed headers verbatim, and a leftover "chunked" key describing the
+    # SENDER's framing would desync the upstream receiver whenever the two
+    # hops' CHUNK_SIZE differ (ADVICE r2).
+    assert "chunked" not in h
+
+
+def test_prealloc_in_place_path(monkeypatch):
+    """Once PREALLOC_COMMIT bytes are committed the receiver writes chunks
+    into a preallocated buffer in place (no trailing 2x copy)."""
+    monkeypatch.setattr(net, "CHUNK_SIZE", 1 << 18)       # 256 KiB chunks
+    monkeypatch.setattr(net, "PREALLOC_COMMIT", 1 << 18)  # prealloc after 1 chunk
+    rng = np.random.default_rng(1)
+    payload = rng.integers(0, 256, 1_200_000, dtype=np.uint8).tobytes()
+    h, p = _roundtrip({"verb": "x"}, payload)
+    assert bytes(p) == payload and "chunked" not in h
 
 
 def test_chunk_exact_multiple(monkeypatch):

@@ -158,3 +158,118 @@ def test_remote_registry_model_roundtrip():
         assert all({r.peer_id for r in blk} == {"x"} for blk in cov)
     finally:
         srv.stop()
+
+
+def test_data_plane_rejects_model_mismatch():
+    """The model id is echoed in every request and the server rejects a
+    mismatch BEFORE touching the executor (ADVICE r2: registry-side scoping
+    alone cannot stop a mis-constructed client from shipping model-A
+    activations into model-B blocks). The error is kind="stage" (retryable),
+    so the client's failover taxonomy blacklists the peer and re-discovers."""
+    import jax.numpy as jnp
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.executor import (
+        StageExecutionError,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.messages import (
+        StageRequest,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.net import (
+        RegistryServer,
+        RemoteRegistry,
+        TcpStageServer,
+        TcpTransport,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("3,6"))
+    spec = plan.stages[1]
+    ex = StageExecutor(cfg, spec, slice_stage_params(cfg, params, spec),
+                       peer_id="srv-a")
+    reg_server = RegistryServer()
+    reg_server.start()
+    srv = TcpStageServer(ex, wire_dtype="f32", model="model-a")
+    srv.start()
+    try:
+        rec = make_server_record("srv-a", spec, model="model-a")
+        rec.address = srv.address
+        reg_server.registry.register(rec)
+        registry = RemoteRegistry(reg_server.address)
+        hidden = jnp.zeros((1, 2, cfg.hidden_size), jnp.float32)
+
+        def _req():
+            return StageRequest(session_id="s", hidden=hidden, seq_len=2,
+                                cur_len=0, is_prefill=True, max_length=8)
+
+        # Wrong model: rejected on both the stream path (stream_open) and
+        # the classic full-metadata frame path.
+        for streams in (True, False):
+            tx_bad = TcpTransport(registry, wire_dtype="f32",
+                                  model="model-b", use_streams=streams)
+            with pytest.raises(StageExecutionError, match="model mismatch"):
+                tx_bad.call("srv-a", _req())
+            tx_bad.close()
+        # Matching model and legacy untagged client both pass.
+        for model in ("model-a", None):
+            tx = TcpTransport(registry, wire_dtype="f32", model=model)
+            resp = tx.call("srv-a", _req())
+            assert resp.hidden is not None
+            tx.end_session("srv-a", "s")
+            tx.close()
+    finally:
+        srv.stop()
+        reg_server.stop()
+
+
+def test_relay_propagates_client_model_tag():
+    """An UNTAGGED legacy hop relaying a push chain must forward the
+    originating client's model tag, not strip it — the tagged downstream
+    server is the one that can still catch the mis-route."""
+    import jax.numpy as jnp
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.executor import (
+        StageExecutionError,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.messages import (
+        StageRequest,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.net import (
+        TcpStageServer,
+        TcpTransport,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("2,4,6"))
+    registry = PlacementRegistry(rng=random.Random(0))
+    servers = []
+    try:
+        # Hop A: legacy untagged. Hop B (final): tagged model-a.
+        for spec, model in ((plan.stages[1], None),
+                            (plan.stages[2], "model-a")):
+            peer = f"relay-s{spec.index}"
+            ex = StageExecutor(cfg, spec, slice_stage_params(cfg, params, spec),
+                               peer_id=peer)
+            srv = TcpStageServer(ex, wire_dtype="f32", model=model)
+            srv.start()
+            servers.append(srv)
+            rec = make_server_record(peer, spec)  # records untagged: the
+            rec.address = srv.address             # mis-route must be possible
+            registry.register(rec)
+        tx = TcpTransport(registry, wire_dtype="f32", model="model-b",
+                          use_streams=False)
+        b_rec = registry.get("relay-s2")
+        with pytest.raises(StageExecutionError, match="model mismatch") as ei:
+            tx.call("relay-s1", StageRequest(
+                session_id="s", seq_len=2, cur_len=0, is_prefill=True,
+                max_length=8,
+                hidden=jnp.zeros((1, 2, cfg.hidden_size), jnp.float32),
+                next_servers=({"peer_id": "relay-s2",
+                               "address": b_rec.address,
+                               "start_block": 4, "end_block": 6},)))
+        assert ei.value.peer_id == "relay-s2"  # blame lands downstream
+        tx.close()
+    finally:
+        for srv in servers:
+            srv.stop()

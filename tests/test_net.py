@@ -480,3 +480,17 @@ def test_stream_per_step_timeout_enforced_via_runtime():
     finally:
         srv.stop()
         reg.stop()
+
+
+def test_end_session_drops_stream_state(swarm):
+    """end_session must free the per-session stream entry too — on a
+    long-lived client connection, ended sessions would otherwise accumulate
+    metadata + 50-token windows until the socket closes (ADVICE r2)."""
+    cfg, params, client, transport, servers, _ = swarm
+    for i in range(3):
+        client.generate([5, 9, 23, 7], max_new_tokens=2,
+                        sampling=SamplingParams(temperature=0.0),
+                        session_id=f"es-{i}")
+    for srv in servers:
+        live = sum(len(d) for d in srv._streams.values())
+        assert live == 0, (srv.executor.peer_id, srv._streams)
