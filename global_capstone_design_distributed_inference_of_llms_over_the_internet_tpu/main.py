@@ -466,21 +466,52 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
     spec = plan.stages[args.stage]
 
     registry = RemoteRegistry(args.registry_addr)
-    ex = _SE(cfg, spec, _stage_params(args, cfg, params, spec),
-             peer_id=args.peer_id or f"stage{args.stage}-{os.getpid()}",
-             offload=args.use_cpu_offload,
-             keep_layers_resident=args.keep_layers_on_gpu)
+    peer_id = args.peer_id or f"stage{args.stage}-{os.getpid()}"
+    if args.batched:
+        # Continuous-batching engine behind the same TCP protocol: plain
+        # sessions coalesce into shared rounds; exotic verbs get a retryable
+        # refusal and clients route them to per-session replicas. Compute
+        # runs inline on handler threads (NOT through a single-threaded
+        # StageRuntime) — the adapter's round window IS the scheduler.
+        if args.use_cpu_offload or args.keep_layers_on_gpu:
+            raise SystemExit(
+                "--batched keeps its span resident in HBM (the batched step "
+                "reads every layer every round); host offload is a "
+                "per-session-executor feature — drop --use_cpu_offload/"
+                "--keep_layers_on_gpu or serve without --batched")
+        from .runtime.batching import BatchedStageExecutor, BatchingStageAdapter
+
+        kv_dtype = (jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32)
+        engine = BatchedStageExecutor(
+            cfg, spec, _stage_params(args, cfg, params, spec),
+            slots=args.slots, max_len=args.max_session_len, dtype=kv_dtype)
+        ex = BatchingStageAdapter(engine, peer_id=peer_id)
+    else:
+        ex = _SE(cfg, spec, _stage_params(args, cfg, params, spec),
+                 peer_id=peer_id,
+                 offload=args.use_cpu_offload,
+                 keep_layers_resident=args.keep_layers_on_gpu)
     logger.info("warming up stage %d (pre-compiling step shapes)", args.stage)
     ex.warmup()
+    # Per-session executors serialize compute through the prioritized
+    # runtime (one compute thread owns the chip; N handler threads own the
+    # sockets — the reference's handlers→Runtime split). The batched engine
+    # must NOT be serialized: concurrent handler calls are how its round
+    # window coalesces, and its own lock + round leadership guard the chip.
+    from .runtime.task_pool import StageRuntime
+
+    runtime = None if args.batched else StageRuntime()
     srv = TcpStageServer(ex, host=args.host, port=args.rpc_port,
-                         wire_dtype=args.wire_dtype, model=_model_id(args))
+                         wire_dtype=args.wire_dtype, model=_model_id(args),
+                         runtime=runtime)
     srv.start()
     # --public_ip overrides the advertised address (the reference's
     # public-maddr-only advertising, component 21 / src/main.py:492-509).
     advert = (f"{args.public_ip}:{srv.address.rsplit(':', 1)[1]}"
               if args.public_ip else srv.address)
     rec = make_server_record(ex.peer_id, spec,
-                             model=_model_id(args))
+                             model=_model_id(args),
+                             engine=getattr(ex, "engine", "session"))
     rec.address = advert
     registry.register(rec)
     print(f"SERVING stage={args.stage} span=[{spec.start},{spec.end}) "
@@ -539,9 +570,14 @@ def _run_serve_elastic(args, cfg: ModelConfig, params) -> int:
 
     peer = args.peer_id or f"lb-{os.getpid()}"
     registry = RemoteRegistry(args.registry_addr)
+    # Serialize compute through the prioritized runtime: elastic servers see
+    # whatever concurrency the swarm sends them, and concurrent per-session
+    # forwards on one executor are not a supported dispatch pattern.
+    from .runtime.task_pool import StageRuntime
+
     srv = TcpStageServer(None, host=args.host, port=args.rpc_port,
                          wire_dtype=args.wire_dtype, peer_id=peer,
-                         model=_model_id(args))
+                         model=_model_id(args), runtime=StageRuntime())
     srv.start()
     advert = (f"{args.public_ip}:{srv.address.rsplit(':', 1)[1]}"
               if args.public_ip else srv.address)
@@ -701,7 +737,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_stages", type=int, default=None,
                    help="fused mode: pipeline depth (default: #devices, <=4)")
     p.add_argument("--tp", type=int, default=1,
-                   help="fused mode: tensor parallelism per stage")
+                   help="fused/serve mode: tensor parallelism per stage "
+                        "(serve: the stage step is sharded over a local "
+                        "('tp',) mesh of N chips)")
+    # Continuous batching in the serving path (the reference's serving
+    # runtime is batch-first, petals/server/server.py:557-671)
+    p.add_argument("--batched", action="store_true",
+                   help="serve mode: continuous slot-batched engine — "
+                        "concurrent plain sessions coalesce into ONE "
+                        "compiled decode step per round; advertised as "
+                        "engine=batched so clients route plain sessions "
+                        "here and beam/speculative/replay to per-session "
+                        "replicas")
+    p.add_argument("--slots", type=int, default=8,
+                   help="serve --batched: max concurrent sessions")
+    p.add_argument("--max_session_len", type=int, default=2048,
+                   help="serve --batched: per-slot KV capacity (tokens)")
     # Network roles (reference --dht_port/--rpc_port/--public_ip surface,
     # src/main.py:776-819, re-homed onto the TCP registry/data plane)
     p.add_argument("--registry_addr", default="127.0.0.1:31330",

@@ -294,6 +294,16 @@ class BatchedStageExecutor:
 
         return fn
 
+    def tokens_left(self) -> int:
+        """Admission headroom for heartbeats/info (the slot-batched analogue
+        of KVArena.tokens_left): free slots at full length plus the unused
+        tail of every occupied slot."""
+        occupied = set(self._slot_of.values())
+        free = self.slots - len(occupied)
+        return int(free * self.max_len
+                   + sum(self.max_len - int(self.lengths[s])
+                         for s in occupied))
+
     def decode_batch(self, inputs: Dict[str, Any]) -> Dict[str, jnp.ndarray]:
         """One batched step. inputs: {session_id: ids [1,1] or hidden
         [1,1,D]}. Returns {session_id: hidden [1,1,D]}. Sessions not in
@@ -360,6 +370,22 @@ class _Round:
         self.closed = False
 
 
+class _SlotArenaView:
+    """KVArena-shaped facade over the slot tables (tokens_left only).
+
+    Takes the adapter's lock: heartbeat/info threads call this while handler
+    threads mutate the slot tables under the same lock — an unlocked dict
+    iteration there can raise mid-resize."""
+
+    def __init__(self, inner: BatchedStageExecutor, lock: threading.Lock):
+        self._inner = inner
+        self._lock = lock
+
+    def tokens_left(self) -> int:
+        with self._lock:
+            return self._inner.tokens_left()
+
+
 class BatchingStageAdapter:
     """Drop-in StageExecutor replacement for transports: plain
     prefill/decode requests ride the batched engine, with concurrent decode
@@ -370,6 +396,8 @@ class BatchingStageAdapter:
     to a per-session replica (the batched path is the common-case fast
     lane, not the whole protocol — see module docstring)."""
 
+    engine = "batched"   # registry capability tag (ServerRecord.engine)
+
     def __init__(self, inner: BatchedStageExecutor, *,
                  window_s: float = 0.003, peer_id: str = "batched",
                  step_timeout: float = 120.0):
@@ -379,14 +407,34 @@ class BatchingStageAdapter:
         self.window_s = window_s
         self.peer_id = peer_id
         self.step_timeout = step_timeout
+        self.requests_served = 0
         self._lock = threading.Lock()
         self._round: Optional[_Round] = None
+        # TcpStageServer's info verb + heartbeat read `.arena.tokens_left()`
+        # on whatever executor they serve; point that surface at the slot
+        # tables so a batched server advertises real admission headroom.
+        self.arena = _SlotArenaView(inner, self._lock)
+
+    def warmup(self) -> None:
+        """Pre-compile the engine's two programs (prefill at the smallest
+        bucket + the batched decode step) so the first real session doesn't
+        pay compile latency — the serve-mode analogue of StageExecutor.warmup."""
+        first = self.spec.is_first
+        d = self.cfg.hidden_size
+        x = (np.zeros((1, 4), np.int32) if first
+             else np.zeros((1, 4, d), np.float32))
+        self.inner.prefill("__warmup__", x)
+        step = (np.zeros((1, 1), np.int32) if first
+                else np.zeros((1, 1, d), np.float32))
+        self.inner.decode_batch({"__warmup__": jnp.asarray(step)})
+        self.inner.end_session("__warmup__")
 
     # -- protocol ----------------------------------------------------------
 
     def forward(self, req) -> "StageResponse":
         from .executor import StageExecutionError
 
+        self.requests_served += 1
         if (req.train or req.hypo_ids is not None or req.num_logprobs
                 or req.draft_tokens is not None or req.is_replay
                 or req.start_from_position not in (None, req.cur_len)):

@@ -51,6 +51,15 @@ REPEAT_STOP = 5           # 5 consecutive identical tokens, src/main.py:197-204
 MAX_COALESCED_TOKENS = 4096
 
 
+def _soft_filter(items, pred):
+    """Routing-policy filter with soft fallback: keep the items matching
+    `pred` unless that would leave none. A candidate that will fail LOUDLY
+    at call time (retryable stage error) beats an immediate NoRouteError
+    when the swarm simply has nothing better."""
+    kept = [it for it in items if pred(it)]
+    return kept or items
+
+
 class NoRouteError(RuntimeError):
     """No live servers cover the required span (route computation failed)."""
 
@@ -183,7 +192,12 @@ class PipelineClient:
         # there too or each failover permanently shrinks that server's
         # advertised cache capacity.
         self._session_peers: Dict[str, set] = {}
-        self._route: Optional[List[Hop]] = None
+        # Route cache per session KIND: plain sessions (False) prefer
+        # engine=batched peers (one compiled step serves every concurrent
+        # session); exotic sessions (True: beam / speculative / anything a
+        # batched peer refuses, batching.py:387-407) avoid them. Keyed so
+        # the two kinds never evict each other's route.
+        self._routes: Dict[bool, List[Hop]] = {}
         # peer -> (rtt_s, measured_at): client-side ping cache for the
         # latency planner's first hop. Route recomputation runs on the
         # RECOVERY path, where serially re-pinging dead candidates (multi-
@@ -201,15 +215,17 @@ class PipelineClient:
     # Routing
     # ------------------------------------------------------------------
 
-    def _compute_route(self) -> List[Hop]:
+    def _compute_route(self, exotic: bool = False) -> List[Hop]:
         if self.use_module_routing:
-            return self._compute_module_route()
+            return self._compute_module_route(exotic)
         hops: List[Hop] = []
         for spec in self.plan.stages[1:]:
             key = f"stage{spec.index}"
             exclude = self.failed_peers.get(key, set())
-            peer = self.registry.discover_stage(spec.index, exclude=tuple(exclude),
-                                                model=self.model)
+            peer = self.registry.discover_stage(
+                spec.index, exclude=tuple(exclude), model=self.model,
+                prefer_engine=None if exotic else "batched",
+                avoid_engine="batched" if exotic else None)
             if peer is None:
                 raise NoRouteError(f"no live server for {key}")
             hops.append(Hop(key, peer, spec.start, spec.end, spec.is_last))
@@ -239,7 +255,7 @@ class PipelineClient:
                         self._ping_cache[pid] = (rtt, now)
         return out
 
-    def _compute_latency_route(self) -> Optional[List[Hop]]:
+    def _compute_latency_route(self, exotic: bool = False) -> Optional[List[Hop]]:
         """Latency-aware module routing: Dijkstra over block coverage using
         server-published next-hop RTTs + the client's own first-hop pings
         (scheduling.routing; the upstream-Petals ping-aware route choice the
@@ -252,6 +268,11 @@ class PipelineClient:
         for peers in self.failed_peers.values():
             exclude |= peers
         records = self.registry.live_servers(model=self.model)
+        if exotic:
+            # Batched peers refuse the exotic verbs — don't even consider
+            # them (plain sessions keep them: the planner optimizes latency,
+            # and a batched hop only helps under concurrency).
+            records = _soft_filter(records, lambda r: r.engine != "batched")
         # Client-side pings for first-hop candidates only (the rest of the
         # route uses server-published RTTs). Pings run CONCURRENTLY and
         # recent measurements are reused — failover triggers a route refresh
@@ -264,6 +285,18 @@ class PipelineClient:
         planned = plan_min_latency_route(
             records, start, self.total_blocks,
             client_rtts=client_rtts, exclude=tuple(exclude))
+        if planned is not None and any(
+                h.record.engine == "batched"
+                and (h.entry != h.record.start_block
+                     or h.end != h.record.end_block)
+                for h in planned):
+            # A batched peer serves its FULL span only (batching.py:396-400);
+            # a sub-span hop through one would be refused at call time.
+            # Re-plan without batched records rather than ship a dead route.
+            planned = plan_min_latency_route(
+                [r for r in records if r.engine != "batched"],
+                start, self.total_blocks,
+                client_rtts=client_rtts, exclude=tuple(exclude))
         if planned is None:
             return None
         hops = [Hop(f"blocks{h.entry}", h.record.peer_id, h.entry, h.end,
@@ -271,13 +304,13 @@ class PipelineClient:
                 for h in planned]
         return hops
 
-    def _compute_module_route(self) -> List[Hop]:
+    def _compute_module_route(self, exotic: bool = False) -> List[Hop]:
         """Greedy block-coverage routing (``src/rpc_transport.py:393-493``):
         cover [stage0_end, total_blocks) hop by hop, each hop the candidate
-        with max end_block (tie-break throughput), loop-guarded, final hop
-        must serve the final stage."""
+        with max end_block (tie-break engine preference, then throughput),
+        loop-guarded, final hop must serve the final stage."""
         if self.route_by_latency:
-            hops = self._compute_latency_route()
+            hops = self._compute_latency_route(exotic)
             if hops is not None:
                 return hops
             logger.warning("latency planner found no route; "
@@ -293,9 +326,21 @@ class PipelineClient:
             # The hop must START at `covered` or earlier; its span past
             # `covered` is what advances coverage.
             cands = [c for c in cands if c.end_block > covered]
+            # Engine compatibility: a batched peer serves its FULL span only
+            # and refuses the exotic verbs (batching.py:387-407). Drop
+            # candidates this session could never call — softly, so a swarm
+            # of only-unusable peers still fails with the clearer retryable
+            # stage error rather than NoRouteError here.
+            cands = _soft_filter(
+                cands,
+                lambda c: (c.engine != "batched"
+                           or (not exotic and c.start_block == covered)))
             if not cands:
                 raise NoRouteError(f"no live server covers block {covered}")
-            best = max(cands, key=lambda c: (c.end_block, c.throughput))
+            best = max(cands, key=lambda c: (
+                c.end_block,
+                (not exotic) and c.engine == "batched",  # prefer batched on
+                c.throughput))                           # equal coverage
             if best.end_block <= covered:  # loop guard, rpc_transport.py:459-461
                 raise NoRouteError(f"route stuck at block {covered}")
             is_final = best.end_block >= self.total_blocks
@@ -308,10 +353,10 @@ class PipelineClient:
             covered = best.end_block
         return hops
 
-    def route(self, refresh: bool = False) -> List[Hop]:
-        if self._route is None or refresh:
-            self._route = self._compute_route()
-        return self._route
+    def route(self, refresh: bool = False, exotic: bool = False) -> List[Hop]:
+        if refresh or exotic not in self._routes:
+            self._routes[exotic] = self._compute_route(exotic)
+        return self._routes[exotic]
 
     # ------------------------------------------------------------------
     # Journal + recovery
@@ -410,6 +455,8 @@ class PipelineClient:
         return peer
 
     def _rediscover_excluding(self, hop: Hop, exclude: Tuple[str, ...]) -> Optional[str]:
+        # The replacement receives the session's REPLAY journal (is_replay +
+        # multi-token chunks), which batched peers refuse — avoid them.
         if self.use_module_routing:
             cands = [
                 c for c in self.registry.discover_block(hop.start_block, exclude=exclude,
@@ -419,12 +466,14 @@ class PipelineClient:
                 if c.start_block <= hop.start_block and c.end_block >= hop.end_block
                 and (not hop.expect_token or c.final_stage)
             ]
+            cands = _soft_filter(cands, lambda c: c.engine != "batched")
             if not cands:
                 return None
             return max(cands, key=lambda c: (c.end_block, c.throughput)).peer_id
         stage_index = int(hop.key.removeprefix("stage"))
         return self.registry.discover_stage(stage_index, exclude=exclude,
-                                            model=self.model)
+                                            model=self.model,
+                                            avoid_engine="batched")
 
     # ------------------------------------------------------------------
     # Pipeline walk
@@ -438,11 +487,15 @@ class PipelineClient:
               hypo_ids: Optional[Tuple[int, ...]] = None,
               num_logprobs: int = 0,
               draft_tokens: Optional[Tuple[int, ...]] = None,
-              start_from_position: Optional[int] = None) -> StageResponse:
+              start_from_position: Optional[int] = None,
+              exotic: bool = False) -> StageResponse:
         """Send the activation through every remote hop; return the final
         hop's response: a sampled token, (num_logprobs > 0, beam mode)
         per-row top-N candidates, or (draft_tokens set, speculative mode)
-        the verified token run."""
+        the verified token run. ``exotic`` is the SESSION's kind (decided
+        once at generate/beam entry, not per step): an exotic session's
+        prefill must already route around batched peers, or its later
+        beam/speculative steps land on a peer that refuses them."""
         sampling = sampling or SamplingParams()
         if self.use_push_chain:
             return self._walk_chain(
@@ -453,7 +506,7 @@ class PipelineClient:
                 start_from_position=start_from_position,
             )
         cur = hidden
-        for hop in self.route():
+        for hop in self.route(exotic=exotic):
             req = StageRequest(
                 session_id=session_id,
                 hidden=cur,
@@ -567,7 +620,7 @@ class PipelineClient:
         blame = blame or hops[0].peer_id
         blamed_hop = next((h for h in hops if h.peer_id == blame), hops[0])
         self.failed_peers.setdefault(blamed_hop.key, set()).add(blame)
-        self._route = None  # recompute with the blacklist applied
+        self._routes.clear()  # recompute with the blacklist applied
         logger.warning("push chain failed at %s: %s", blame, exc)
 
     def _walk_chain(self, hidden, seq_len: int, cur_len: int, session_id: str,
@@ -580,9 +633,16 @@ class PipelineClient:
         touched = self._session_peers.setdefault(session_id, set())
         last_exc: Optional[Exception] = None
         blacklist_cleared = False
+        # Chain sessions are ALWAYS exotic-routed: every retry ships
+        # is_replay=True (attempt > 0 below) and recovery replays the whole
+        # journal through the chain — both refused by batched peers, so a
+        # batched-preferring chain could never recover from a transient
+        # fault (it would blacklist healthy batched peers until attempts
+        # ran out).
+        exotic = True
         for attempt in range(MAX_ATTEMPTS):
             try:
-                hops = self.route()
+                hops = self.route(exotic=exotic)
             except NoRouteError as exc:
                 last_exc = exc
                 if blacklist_cleared:
@@ -592,7 +652,7 @@ class PipelineClient:
                 # path's _rediscover, client.py _rediscover).
                 blacklist_cleared = True
                 self.failed_peers.clear()
-                self._route = None
+                self._routes.clear()
                 continue
             touched.update(h.peer_id for h in hops)
             req = self._chain_request(
@@ -614,7 +674,7 @@ class PipelineClient:
                 last_exc = exc
                 self._blame_chain_failure(hops, exc)
                 try:
-                    new_hops = self.route()
+                    new_hops = self.route(exotic=exotic)
                     self._replay_chain(new_hops, session_id, sampling,
                                        max_length)
                 except NoRouteError as rexc:
@@ -677,6 +737,10 @@ class PipelineClient:
         sampling = sampling or SamplingParams()
         session_id = session_id or f"sess-{time.monotonic_ns():x}"
         prompt_len = len(prompt_ids)
+        # Session kind is fixed at entry: a speculative session's PREFILL
+        # must already avoid batched peers (they refuse draft steps), and a
+        # plain session prefers them.
+        exotic = speculative_k > 0
         max_length = max_length or (
             prompt_len + max_new_tokens
             + (speculative_k if speculative_k > 0 else 0))
@@ -696,6 +760,7 @@ class PipelineClient:
             s0_resp.hidden, prompt_len, 0, session_id,
             is_prefill=True, max_length=max_length, sampling=sampling,
             generated=generated, step_seed=self.seed, stage_times=times,
+            exotic=exotic,
         )
         ttft = time.monotonic() - t0
         self.last_prefill_stage_times = times
@@ -742,6 +807,7 @@ class PipelineClient:
                 stage_times=times,
                 draft_tokens=drafts if drafts else None,
                 start_from_position=spos,
+                exotic=exotic,
             )
             accepted = list(resp.tokens) if drafts else [resp.token_id]
             if drafts:
@@ -785,7 +851,8 @@ class PipelineClient:
         a replacement peer — contiguity is preserved because the next round's
         cur_len advances by exactly `keep`."""
         keys = ([self.CHAIN_KEY] if self.use_push_chain
-                else [hop.key for hop in (self._route or [])])
+                else [hop.key for hops in self._routes.values()
+                      for hop in hops])
         for key in keys:
             entries = self.journal.get(key, {}).get(session_id)
             if entries:
@@ -835,6 +902,7 @@ class PipelineClient:
         resp = self._walk(
             s0_resp.hidden, prompt_len, 0, session_id, is_prefill=True,
             max_length=max_length, num_logprobs=topn, stage_times=times,
+            exotic=True,
         )
         ttft = time.monotonic() - t0
         self.last_prefill_stage_times = times
@@ -878,7 +946,7 @@ class PipelineClient:
             resp = self._walk(
                 s0_resp.hidden, 1, cur_len, session_id,
                 is_prefill=False, max_length=max_length, num_logprobs=topn,
-                hypo_ids=hypo, stage_times=times,
+                hypo_ids=hypo, stage_times=times, exotic=True,
             )
             self.decode_stage_history.append(times)
             cur_len += 1
@@ -922,8 +990,8 @@ class PipelineClient:
         # current route hops PLUS peers abandoned by failover — without this,
         # each generation (or failover) permanently consumes arena budget.
         peers = set(self._session_peers.pop(session_id, ()))
-        if self._route:
-            peers.update(hop.peer_id for hop in self._route)
+        for hops in self._routes.values():
+            peers.update(hop.peer_id for hop in hops)
         for peer_id in peers:
             try:
                 self.transport.end_session(peer_id, session_id)
@@ -935,7 +1003,8 @@ class PipelineClient:
 
 def make_server_record(peer_id: str, spec: StageSpec, *, throughput: float = 1.0,
                        cache_tokens_left: Optional[int] = None,
-                       model: Optional[str] = None) -> ServerRecord:
+                       model: Optional[str] = None,
+                       engine: str = "session") -> ServerRecord:
     """Registry record for a fixed-split stage server (the triple DHT publish
     of ``src/main.py:656-697`` collapsed into one record)."""
     return ServerRecord(
@@ -947,4 +1016,5 @@ def make_server_record(peer_id: str, spec: StageSpec, *, throughput: float = 1.0
         stage_index=spec.index,
         cache_tokens_left=cache_tokens_left,
         model=model,
+        engine=engine,
     )

@@ -236,7 +236,10 @@ class DistributedFineTuner:
 
     def _step_once(self, ids: jnp.ndarray, targets: jnp.ndarray,
                    refresh_route: bool) -> float:
-        hops = self.client.route(refresh=refresh_route)
+        # exotic=True: training verbs (train_forward/backward) only exist on
+        # per-session executors — a batched peer in the route would fail
+        # every step (batched engines serve plain inference only).
+        hops = self.client.route(refresh=refresh_route, exotic=True)
         self._session_n += 1
         session_id = f"ft-{id(self):x}-{self._session_n}"
         tr = self.trainables

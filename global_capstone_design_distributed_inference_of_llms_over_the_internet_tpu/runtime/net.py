@@ -598,13 +598,20 @@ class TcpStageServer(_FramedTcpServer):
             _send_frame(sock, {"verb": "ok"})
         elif verb == "info":
             spec = ex.spec
-            _send_frame(sock, {
+            frame = {
                 "verb": "info", "peer_id": ex.peer_id,
                 "start_block": spec.start, "end_block": spec.end,
                 "cache_tokens_left": ex.arena.tokens_left(),
                 "requests_served": ex.requests_served,
+                "engine": getattr(ex, "engine", "session"),
                 "version": 1,
-            })
+            }
+            # Batched engines expose their coalescing effectiveness (rounds
+            # executed vs requests served) for tests + ops introspection.
+            steps = getattr(getattr(ex, "inner", None), "decode_steps", None)
+            if steps is not None:
+                frame["decode_steps"] = steps
+            _send_frame(sock, frame)
         else:
             _send_frame(sock, {"verb": "error",
                                "message": f"unknown verb {verb!r}"})
@@ -1285,7 +1292,7 @@ def check_direct_reachability(transport: TcpTransport, registry,
 
 _REC_FIELDS = ("peer_id", "start_block", "end_block", "throughput", "state",
                "final_stage", "stage_index", "cache_tokens_left", "address",
-               "next_server_rtts", "model")
+               "next_server_rtts", "model", "engine")
 
 
 def _rec_to_dict(rec: ServerRecord) -> dict:
@@ -1293,7 +1300,10 @@ def _rec_to_dict(rec: ServerRecord) -> dict:
 
 
 def _dict_to_rec(d: dict) -> ServerRecord:
-    return ServerRecord(**{f: d.get(f) for f in _REC_FIELDS})
+    vals = {f: d.get(f) for f in _REC_FIELDS}
+    if vals.get("engine") is None:      # record from a pre-engine peer
+        vals["engine"] = "session"
+    return ServerRecord(**vals)
 
 
 class RegistryServer(_FramedTcpServer):
@@ -1428,9 +1438,12 @@ class RemoteRegistry:
         self._refresh()
         return self._local.get(peer_id)
 
-    def discover_stage(self, stage_index: int, exclude=(), model=None):
+    def discover_stage(self, stage_index: int, exclude=(), model=None,
+                       prefer_engine=None, avoid_engine=None):
         self._refresh()
-        return self._local.discover_stage(stage_index, exclude, model=model)
+        return self._local.discover_stage(stage_index, exclude, model=model,
+                                          prefer_engine=prefer_engine,
+                                          avoid_engine=avoid_engine)
 
     def discover_block(self, block: int, exclude=(), model=None):
         self._refresh()

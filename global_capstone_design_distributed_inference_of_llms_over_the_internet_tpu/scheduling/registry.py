@@ -60,6 +60,14 @@ class ServerRecord:
     # control plane; records with different models never cross-route. None =
     # single-model swarm (matches any query — the pre-multi-model schema).
     model: Optional[str] = None
+    # Serving engine capability: "session" (per-session executor — the full
+    # protocol incl. beam/speculative/replay) or "batched" (continuous
+    # slot-batched decode — plain prefill/decode only, but one compiled step
+    # serves every concurrent session). Clients prefer batched peers for
+    # plain sessions and per-session peers for the exotic verbs; the
+    # reference's serving runtime is batch-first throughout
+    # (petals/server/server.py:557-671).
+    engine: str = "session"
     stage_index: Optional[int] = None      # fixed-split mode stage number
     cache_tokens_left: Optional[int] = None  # petals/server/server.py:721
     address: Optional[str] = None          # "host:port" for the TCP data plane
@@ -160,15 +168,28 @@ class PlacementRegistry:
 
     def discover_stage(self, stage_index: int,
                        exclude: Sequence[str] = (),
-                       model: Optional[str] = None) -> Optional[str]:
+                       model: Optional[str] = None,
+                       prefer_engine: Optional[str] = None,
+                       avoid_engine: Optional[str] = None) -> Optional[str]:
         """Pick a server for a fixed-split stage: random among the 5 newest
         live candidates, excluding known-failed peers
-        (``src/rpc_transport.py:270-353``)."""
+        (``src/rpc_transport.py:270-353``). `prefer_engine` narrows to that
+        engine when any such candidate exists (soft); `avoid_engine` drops
+        those candidates unless nothing else remains (a session that a
+        batched peer would refuse should not be routed to one)."""
         cands = [
             r for r in self._live(model=model)
             if r.stage_index == stage_index and r.peer_id not in exclude
             and r.state == ServerState.ONLINE
         ]
+        if avoid_engine is not None:
+            kept = [r for r in cands if r.engine != avoid_engine]
+            if kept:
+                cands = kept
+        if prefer_engine is not None:
+            preferred = [r for r in cands if r.engine == prefer_engine]
+            if preferred:
+                cands = preferred
         return self._pick_newest(cands)
 
     def discover_block(self, block: int, exclude: Sequence[str] = (),

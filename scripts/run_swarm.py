@@ -51,12 +51,25 @@ def main():
                    help="--lb: how many elastic servers to spawn")
     p.add_argument("--num_blocks", type=int, default=None,
                    help="--lb: blocks per elastic server")
+    p.add_argument("--batched", action="store_true",
+                   help="fixed-split servers use the continuous-batching "
+                        "engine (--mode serve --batched)")
+    p.add_argument("--slots", type=int, default=8,
+                   help="--batched: concurrent sessions per server")
     args = p.parse_args()
 
     num_stages = len(args.splits.split(","))  # stages 1..N (0 = client)
     reg_addr = f"127.0.0.1:{args.registry_port}"
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
+    if env.get("JAX_PLATFORMS") == "cpu":
+        # A CPU swarm must not register the axon TPU plugin in each
+        # subprocess: its sitecustomize hook routes even CPU compiles
+        # through the shared remote compile service, so a down/wedged
+        # tunnel would hang every server's warmup. Empty pool-ips skips
+        # the registration entirely (local CPU compiles) — overriding any
+        # inherited pool config, since the subprocesses are CPU-only here.
+        env["PALLAS_AXON_POOL_IPS"] = ""
     procs = []
 
     def spawn(role_args, log_name):
@@ -96,6 +109,8 @@ def main():
                     role += ["--num_blocks", str(args.num_blocks)]
             else:
                 role += ["--stage", str(i)]
+                if args.batched:
+                    role += ["--batched", "--slots", str(args.slots)]
             spawn(common + role, f"stage{i}")
 
         # Readiness = every server's record is live AND ONLINE in the
