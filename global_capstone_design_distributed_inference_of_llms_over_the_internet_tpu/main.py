@@ -448,6 +448,21 @@ def run_registry(args, cfg: ModelConfig, params) -> int:
     return 0
 
 
+def _serve_tp_mesh(args):
+    """Local ('tp',) mesh for --mode serve --tp N: one server process using
+    N chips for its stage (the reference wraps every serving block in TP,
+    petals/server/backend.py:43). None when tp <= 1."""
+    if args.tp <= 1:
+        return None
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < args.tp:
+        raise SystemExit(
+            f"--tp {args.tp} needs {args.tp} local devices, found {len(devs)}")
+    return Mesh(np.asarray(devs[:args.tp]), ("tp",))
+
+
 def run_serve(args, cfg: ModelConfig, params) -> int:
     import os
 
@@ -467,7 +482,29 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
 
     registry = RemoteRegistry(args.registry_addr)
     peer_id = args.peer_id or f"stage{args.stage}-{os.getpid()}"
-    if args.batched:
+    if args.sp > 1 and (args.batched or args.tp > 1 or args.use_cpu_offload):
+        raise SystemExit("--sp does not compose with --batched/--tp/"
+                         "--use_cpu_offload on one server")
+    if args.sp > 1:
+        # Sequence-parallel long-context engine: ONE session at a time, its
+        # prefix KV sharded along T over the local ('sp',) mesh.
+        from jax.sharding import Mesh as _Mesh
+
+        from .parallel.sp_stage import SpStageRunner
+        from .runtime.sp_serve import SpStageAdapter
+
+        devs = jax.devices()
+        if len(devs) < args.sp:
+            raise SystemExit(f"--sp {args.sp} needs {args.sp} local devices, "
+                             f"found {len(devs)}")
+        mesh = _Mesh(np.asarray(devs[:args.sp]), ("sp",))
+        runner = SpStageRunner(cfg, spec,
+                               _stage_params(args, cfg, params, spec), mesh,
+                               dtype=_DTYPE_MAP[args.dtype])
+        # max_context default (8192/chip + tail) is the ADAPTER's policy.
+        ex = SpStageAdapter(runner, peer_id=peer_id,
+                            max_context=args.max_context)
+    elif args.batched:
         # Continuous-batching engine behind the same TCP protocol: plain
         # sessions coalesce into shared rounds; exotic verbs get a retryable
         # refusal and clients route them to per-session replicas. Compute
@@ -479,6 +516,9 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
                 "reads every layer every round); host offload is a "
                 "per-session-executor feature — drop --use_cpu_offload/"
                 "--keep_layers_on_gpu or serve without --batched")
+        if args.tp > 1:
+            raise SystemExit("--batched does not compose with --tp yet; "
+                             "serve per-session (--tp N) or batched (--batched)")
         from .runtime.batching import BatchedStageExecutor, BatchingStageAdapter
 
         kv_dtype = (jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32)
@@ -490,7 +530,8 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
         ex = _SE(cfg, spec, _stage_params(args, cfg, params, spec),
                  peer_id=peer_id,
                  offload=args.use_cpu_offload,
-                 keep_layers_resident=args.keep_layers_on_gpu)
+                 keep_layers_resident=args.keep_layers_on_gpu,
+                 tp_mesh=_serve_tp_mesh(args))
     logger.info("warming up stage %d (pre-compiling step shapes)", args.stage)
     ex.warmup()
     # Per-session executors serialize compute through the prioritized
@@ -500,7 +541,10 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
     # window coalesces, and its own lock + round leadership guard the chip.
     from .runtime.task_pool import StageRuntime
 
-    runtime = None if args.batched else StageRuntime()
+    # The batched engine must NOT be serialized (concurrent handler calls
+    # are how its round window coalesces); the sp adapter serializes itself
+    # with its own lock (one session owns the mesh anyway).
+    runtime = None if (args.batched or args.sp > 1) else StageRuntime()
     srv = TcpStageServer(ex, host=args.host, port=args.rpc_port,
                          wire_dtype=args.wire_dtype, model=_model_id(args),
                          runtime=runtime)
@@ -512,6 +556,7 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
     rec = make_server_record(ex.peer_id, spec,
                              model=_model_id(args),
                              engine=getattr(ex, "engine", "session"))
+    rec.max_context = getattr(ex, "max_context", None)
     rec.address = advert
     registry.register(rec)
     print(f"SERVING stage={args.stage} span=[{spec.start},{spec.end}) "
@@ -606,7 +651,7 @@ def _run_serve_elastic(args, cfg: ModelConfig, params) -> int:
 
         num_blocks = derive_num_blocks(
             cfg, dtype_bytes=jnp.dtype(_DTYPE_MAP[args.dtype]).itemsize,
-            quant=args.quant)
+            quant=args.quant, tp=args.tp)
         if num_blocks is not None:
             num_blocks = min(num_blocks, max(total - min_block, 1))
     num_blocks = num_blocks or max(1, (total - min_block) // 3)
@@ -622,7 +667,8 @@ def _run_serve_elastic(args, cfg: ModelConfig, params) -> int:
         mean_balance_check_period=args.mean_balance_check_period,
         bandwidth_mbps=args.network_bandwidth_mbps,
         executor_kwargs={"offload": args.use_cpu_offload,
-                         "keep_layers_resident": args.keep_layers_on_gpu},
+                         "keep_layers_resident": args.keep_layers_on_gpu,
+                         "tp_mesh": _serve_tp_mesh(args)},
         advertise_address=advert, warmup=True,
         rng=random.Random(args.seed + os.getpid()),
         model=_model_id(args),
@@ -662,6 +708,7 @@ def run_client(args, cfg: ModelConfig, params) -> int:
         request_timeout=args.request_timeout,
         seed=args.seed,
         model=_model_id(args),
+        long_context_threshold=args.long_context_threshold,
     )
     try:
         return _generate_and_report(args, client.generate, cfg)
@@ -753,6 +800,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve --batched: max concurrent sessions")
     p.add_argument("--max_session_len", type=int, default=2048,
                    help="serve --batched: per-slot KV capacity (tokens)")
+    # Sequence-parallel long-context serving (SURVEY §5.7 exceed-the-
+    # reference axis: the reference's KV must fit one machine)
+    p.add_argument("--sp", type=int, default=1,
+                   help="serve mode: sequence parallelism — the session's "
+                        "prefix KV shards along the sequence axis of a "
+                        "local ('sp',) mesh of N chips, so prompts beyond "
+                        "one device's KV budget serve end-to-end; "
+                        "advertised as engine=sp with --max_context")
+    p.add_argument("--max_context", type=int, default=None,
+                   help="serve --sp: advertised admission limit "
+                        "(prompt+generated tokens); default 8192 per chip")
+    p.add_argument("--long_context_threshold", type=int, default=None,
+                   help="client mode: prompts at/above this length route "
+                        "to engine=sp peers")
     # Network roles (reference --dht_port/--rpc_port/--public_ip surface,
     # src/main.py:776-819, re-homed onto the TCP registry/data plane)
     p.add_argument("--registry_addr", default="127.0.0.1:31330",

@@ -289,6 +289,13 @@ class SpStageRunner:
         self.tail_len += 1
         return h
 
+    def reset(self) -> None:
+        """Drop the session's caches (serving end_session): the sharded
+        prefix and replicated tail buffers are freed; compiled fns stay."""
+        self.pk = self.pv = None
+        self.tk = self.tv = None
+        self.prefix_pad = self.prefix_len = self.tail_len = 0
+
     # ------------------------------------------------------------------
 
     def logits_at(self, hidden: jnp.ndarray, position: int) -> jnp.ndarray:

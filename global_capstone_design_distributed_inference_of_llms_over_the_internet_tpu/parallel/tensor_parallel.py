@@ -128,12 +128,15 @@ def make_tp_stage_fn(
     spec: StageSpec,
     mesh: Mesh,
     axis: str = "tp",
+    donate_cache: bool = False,
 ):
     """Jitted TP stage forward. Caller passes params placed by
     `shard_stage_params` and a KV cache sharded over kv heads
     ([L, B, S, Hkv, Dh] with spec P(None, None, None, axis)).
 
     Returns fn(params, x, k, v, cache_len) -> (out, k, v); out replicated.
+    `donate_cache=True` donates the k/v buffers (serving: the caller
+    threads the returned cache and never reuses the input arrays).
     """
     tp = mesh.shape[axis]
     validate_tp(cfg, tp)
@@ -143,7 +146,8 @@ def make_tp_stage_fn(
         in_specs = (stage_param_specs(cfg, params_example, axis), P(),
                     kv_spec, kv_spec, P())
 
-        @jax.jit
+        @partial(jax.jit,
+                 donate_argnums=(2, 3) if donate_cache else ())
         @partial(
             jax.shard_map, mesh=mesh,
             in_specs=in_specs, out_specs=(P(), kv_spec, kv_spec),

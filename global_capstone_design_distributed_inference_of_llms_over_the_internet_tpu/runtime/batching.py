@@ -373,17 +373,25 @@ class _Round:
 class _SlotArenaView:
     """KVArena-shaped facade over the slot tables (tokens_left only).
 
-    Takes the adapter's lock: heartbeat/info threads call this while handler
+    Takes the adapter's lock (heartbeat/info threads call this while handler
     threads mutate the slot tables under the same lock — an unlocked dict
-    iteration there can raise mid-resize."""
+    iteration there can raise mid-resize), but with a BOUNDED wait: the
+    adapter holds its lock across whole prefill dispatches (including
+    compiles), and blocking the heartbeat thread past the registry TTL would
+    expire a healthy server. A busy adapter returns the last known value."""
 
     def __init__(self, inner: BatchedStageExecutor, lock: threading.Lock):
         self._inner = inner
         self._lock = lock
+        self._last = inner.slots * inner.max_len
 
     def tokens_left(self) -> int:
-        with self._lock:
-            return self._inner.tokens_left()
+        if self._lock.acquire(timeout=0.5):
+            try:
+                self._last = self._inner.tokens_left()
+            finally:
+                self._lock.release()
+        return self._last
 
 
 class BatchingStageAdapter:

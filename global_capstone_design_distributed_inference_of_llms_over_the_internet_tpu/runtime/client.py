@@ -51,6 +51,28 @@ REPEAT_STOP = 5           # 5 consecutive identical tokens, src/main.py:197-204
 MAX_COALESCED_TOKENS = 4096
 
 
+# Engines that serve plain prefill/decode of their FULL span only: they
+# refuse beam/speculative/training/replay and sub-span requests, so exotic
+# sessions and replay-failover must route around them.
+SESSION_ONLY_ENGINES = ("batched", "sp")
+
+
+def _engine_usable(rec, kind: str, full_span: bool = True,
+                   min_context: Optional[int] = None) -> bool:
+    """Can a session of `kind` (needing `min_context` total tokens) call
+    `rec`'s engine for a hop that covers its full span iff `full_span`?"""
+    if rec.engine not in SESSION_ONLY_ENGINES:
+        return True
+    if kind == "exotic" or not full_span:
+        return False
+    if (min_context is not None and rec.max_context is not None
+            and rec.max_context < min_context):
+        # An sp peer advertising a smaller context than this session needs
+        # WILL refuse the prefill — don't route there just to bounce.
+        return False
+    return True
+
+
 def _soft_filter(items, pred):
     """Routing-policy filter with soft fallback: keep the items matching
     `pred` unless that would leave none. A candidate that will fail LOUDLY
@@ -155,6 +177,7 @@ class PipelineClient:
         journal_max_entries: int = 256,
         seed: int = 0,
         model: Optional[str] = None,
+        long_context_threshold: Optional[int] = None,
     ):
         self.cfg = cfg
         # Multi-model swarm: every discovery/coverage query is scoped to this
@@ -181,6 +204,10 @@ class PipelineClient:
         self.settle_seconds = settle_seconds
         self.journal_max_entries = journal_max_entries
         self.seed = seed
+        # Prompts at/above this length route as kind="long" (preferring
+        # engine=sp peers whose prefix cache shards across a mesh). None =
+        # never classify by length.
+        self.long_context_threshold = long_context_threshold
 
         # hop key -> session -> activation journal (src/rpc_transport.py:106)
         self.journal: Dict[str, Dict[str, List[JournalEntry]]] = {}
@@ -192,12 +219,16 @@ class PipelineClient:
         # there too or each failover permanently shrinks that server's
         # advertised cache capacity.
         self._session_peers: Dict[str, set] = {}
-        # Route cache per session KIND: plain sessions (False) prefer
-        # engine=batched peers (one compiled step serves every concurrent
-        # session); exotic sessions (True: beam / speculative / anything a
-        # batched peer refuses, batching.py:387-407) avoid them. Keyed so
-        # the two kinds never evict each other's route.
-        self._routes: Dict[bool, List[Hop]] = {}
+        # Route cache per session KIND:
+        #   "plain"  — prefers engine=batched peers (one compiled step
+        #              serves every concurrent session);
+        #   "long"   — prefers engine=sp peers (prefix KV sharded across a
+        #              mesh: context beyond one device's budget);
+        #   "exotic" — beam / speculative / training / anything the
+        #              single-session engines refuse (batching.py:387-407)
+        #              routes around them.
+        # Keyed so kinds never evict each other's route.
+        self._routes: Dict[str, List[Hop]] = {}
         # peer -> (rtt_s, measured_at): client-side ping cache for the
         # latency planner's first hop. Route recomputation runs on the
         # RECOVERY path, where serially re-pinging dead candidates (multi-
@@ -215,17 +246,19 @@ class PipelineClient:
     # Routing
     # ------------------------------------------------------------------
 
-    def _compute_route(self, exotic: bool = False) -> List[Hop]:
+    def _compute_route(self, kind: str = "plain",
+                       min_context: Optional[int] = None) -> List[Hop]:
         if self.use_module_routing:
-            return self._compute_module_route(exotic)
+            return self._compute_module_route(kind, min_context)
         hops: List[Hop] = []
         for spec in self.plan.stages[1:]:
             key = f"stage{spec.index}"
             exclude = self.failed_peers.get(key, set())
             peer = self.registry.discover_stage(
                 spec.index, exclude=tuple(exclude), model=self.model,
-                prefer_engine=None if exotic else "batched",
-                avoid_engine="batched" if exotic else None)
+                prefer_engine={"plain": "batched", "long": "sp"}.get(kind),
+                avoid_engine=SESSION_ONLY_ENGINES if kind == "exotic" else None,
+                min_context=min_context)
             if peer is None:
                 raise NoRouteError(f"no live server for {key}")
             hops.append(Hop(key, peer, spec.start, spec.end, spec.is_last))
@@ -255,7 +288,8 @@ class PipelineClient:
                         self._ping_cache[pid] = (rtt, now)
         return out
 
-    def _compute_latency_route(self, exotic: bool = False) -> Optional[List[Hop]]:
+    def _compute_latency_route(self, kind: str = "plain",
+                               min_context: Optional[int] = None) -> Optional[List[Hop]]:
         """Latency-aware module routing: Dijkstra over block coverage using
         server-published next-hop RTTs + the client's own first-hop pings
         (scheduling.routing; the upstream-Petals ping-aware route choice the
@@ -268,11 +302,19 @@ class PipelineClient:
         for peers in self.failed_peers.values():
             exclude |= peers
         records = self.registry.live_servers(model=self.model)
-        if exotic:
-            # Batched peers refuse the exotic verbs — don't even consider
-            # them (plain sessions keep them: the planner optimizes latency,
-            # and a batched hop only helps under concurrency).
-            records = _soft_filter(records, lambda r: r.engine != "batched")
+        if kind == "exotic":
+            # Single-session engines refuse the exotic verbs — don't even
+            # consider them (plain sessions keep them: the planner optimizes
+            # latency, and engine preference is secondary there).
+            records = _soft_filter(
+                records, lambda r: r.engine not in SESSION_ONLY_ENGINES)
+        elif min_context is not None:
+            # sp peers advertising less context than this session needs
+            # would refuse the prefill.
+            records = _soft_filter(
+                records,
+                lambda r: (r.engine != "sp" or r.max_context is None
+                           or r.max_context >= min_context))
         # Client-side pings for first-hop candidates only (the rest of the
         # route uses server-published RTTs). Pings run CONCURRENTLY and
         # recent measurements are reused — failover triggers a route refresh
@@ -286,15 +328,16 @@ class PipelineClient:
             records, start, self.total_blocks,
             client_rtts=client_rtts, exclude=tuple(exclude))
         if planned is not None and any(
-                h.record.engine == "batched"
+                h.record.engine in SESSION_ONLY_ENGINES
                 and (h.entry != h.record.start_block
                      or h.end != h.record.end_block)
                 for h in planned):
-            # A batched peer serves its FULL span only (batching.py:396-400);
-            # a sub-span hop through one would be refused at call time.
-            # Re-plan without batched records rather than ship a dead route.
+            # Single-session engines serve their FULL span only
+            # (batching.py:396-400); a sub-span hop through one would be
+            # refused at call time. Re-plan without them rather than ship a
+            # dead route.
             planned = plan_min_latency_route(
-                [r for r in records if r.engine != "batched"],
+                [r for r in records if r.engine not in SESSION_ONLY_ENGINES],
                 start, self.total_blocks,
                 client_rtts=client_rtts, exclude=tuple(exclude))
         if planned is None:
@@ -304,13 +347,14 @@ class PipelineClient:
                 for h in planned]
         return hops
 
-    def _compute_module_route(self, exotic: bool = False) -> List[Hop]:
+    def _compute_module_route(self, kind: str = "plain",
+                              min_context: Optional[int] = None) -> List[Hop]:
         """Greedy block-coverage routing (``src/rpc_transport.py:393-493``):
         cover [stage0_end, total_blocks) hop by hop, each hop the candidate
         with max end_block (tie-break engine preference, then throughput),
         loop-guarded, final hop must serve the final stage."""
         if self.route_by_latency:
-            hops = self._compute_latency_route(exotic)
+            hops = self._compute_latency_route(kind, min_context)
             if hops is not None:
                 return hops
             logger.warning("latency planner found no route; "
@@ -326,21 +370,23 @@ class PipelineClient:
             # The hop must START at `covered` or earlier; its span past
             # `covered` is what advances coverage.
             cands = [c for c in cands if c.end_block > covered]
-            # Engine compatibility: a batched peer serves its FULL span only
-            # and refuses the exotic verbs (batching.py:387-407). Drop
-            # candidates this session could never call — softly, so a swarm
-            # of only-unusable peers still fails with the clearer retryable
-            # stage error rather than NoRouteError here.
+            # Engine compatibility: single-session engines serve their FULL
+            # span only and refuse the exotic verbs (batching.py:387-407).
+            # Drop candidates this session could never call — softly, so a
+            # swarm of only-unusable peers still fails with the clearer
+            # retryable stage error rather than NoRouteError here.
             cands = _soft_filter(
                 cands,
-                lambda c: (c.engine != "batched"
-                           or (not exotic and c.start_block == covered)))
+                lambda c: _engine_usable(c, kind,
+                                         full_span=c.start_block == covered,
+                                         min_context=min_context))
             if not cands:
                 raise NoRouteError(f"no live server covers block {covered}")
+            prefer = {"plain": "batched", "long": "sp"}.get(kind)
             best = max(cands, key=lambda c: (
                 c.end_block,
-                (not exotic) and c.engine == "batched",  # prefer batched on
-                c.throughput))                           # equal coverage
+                c.engine == prefer,    # engine preference on equal coverage
+                c.throughput))
             if best.end_block <= covered:  # loop guard, rpc_transport.py:459-461
                 raise NoRouteError(f"route stuck at block {covered}")
             is_final = best.end_block >= self.total_blocks
@@ -353,10 +399,12 @@ class PipelineClient:
             covered = best.end_block
         return hops
 
-    def route(self, refresh: bool = False, exotic: bool = False) -> List[Hop]:
-        if refresh or exotic not in self._routes:
-            self._routes[exotic] = self._compute_route(exotic)
-        return self._routes[exotic]
+    def route(self, refresh: bool = False, kind: str = "plain",
+              min_context: Optional[int] = None) -> List[Hop]:
+        key = (kind, min_context)
+        if refresh or key not in self._routes:
+            self._routes[key] = self._compute_route(kind, min_context)
+        return self._routes[key]
 
     # ------------------------------------------------------------------
     # Journal + recovery
@@ -456,7 +504,7 @@ class PipelineClient:
 
     def _rediscover_excluding(self, hop: Hop, exclude: Tuple[str, ...]) -> Optional[str]:
         # The replacement receives the session's REPLAY journal (is_replay +
-        # multi-token chunks), which batched peers refuse — avoid them.
+        # multi-token chunks), which single-session engines refuse — avoid.
         if self.use_module_routing:
             cands = [
                 c for c in self.registry.discover_block(hop.start_block, exclude=exclude,
@@ -466,14 +514,15 @@ class PipelineClient:
                 if c.start_block <= hop.start_block and c.end_block >= hop.end_block
                 and (not hop.expect_token or c.final_stage)
             ]
-            cands = _soft_filter(cands, lambda c: c.engine != "batched")
+            cands = _soft_filter(
+                cands, lambda c: c.engine not in SESSION_ONLY_ENGINES)
             if not cands:
                 return None
             return max(cands, key=lambda c: (c.end_block, c.throughput)).peer_id
         stage_index = int(hop.key.removeprefix("stage"))
         return self.registry.discover_stage(stage_index, exclude=exclude,
                                             model=self.model,
-                                            avoid_engine="batched")
+                                            avoid_engine=SESSION_ONLY_ENGINES)
 
     # ------------------------------------------------------------------
     # Pipeline walk
@@ -488,14 +537,16 @@ class PipelineClient:
               num_logprobs: int = 0,
               draft_tokens: Optional[Tuple[int, ...]] = None,
               start_from_position: Optional[int] = None,
-              exotic: bool = False) -> StageResponse:
+              kind: str = "plain",
+              min_context: Optional[int] = None) -> StageResponse:
         """Send the activation through every remote hop; return the final
         hop's response: a sampled token, (num_logprobs > 0, beam mode)
         per-row top-N candidates, or (draft_tokens set, speculative mode)
-        the verified token run. ``exotic`` is the SESSION's kind (decided
-        once at generate/beam entry, not per step): an exotic session's
-        prefill must already route around batched peers, or its later
-        beam/speculative steps land on a peer that refuses them."""
+        the verified token run. ``kind`` is the SESSION's routing kind
+        (decided once at generate/beam entry, not per step): an exotic
+        session's prefill must already route around single-session engines,
+        or its later beam/speculative steps land on a peer that refuses
+        them."""
         sampling = sampling or SamplingParams()
         if self.use_push_chain:
             return self._walk_chain(
@@ -506,7 +557,7 @@ class PipelineClient:
                 start_from_position=start_from_position,
             )
         cur = hidden
-        for hop in self.route(exotic=exotic):
+        for hop in self.route(kind=kind, min_context=min_context):
             req = StageRequest(
                 session_id=session_id,
                 hidden=cur,
@@ -635,14 +686,13 @@ class PipelineClient:
         blacklist_cleared = False
         # Chain sessions are ALWAYS exotic-routed: every retry ships
         # is_replay=True (attempt > 0 below) and recovery replays the whole
-        # journal through the chain — both refused by batched peers, so a
-        # batched-preferring chain could never recover from a transient
-        # fault (it would blacklist healthy batched peers until attempts
+        # journal through the chain — both refused by the single-session
+        # engines, so a batched/sp-preferring chain could never recover from
+        # a transient fault (it would blacklist healthy peers until attempts
         # ran out).
-        exotic = True
         for attempt in range(MAX_ATTEMPTS):
             try:
-                hops = self.route(exotic=exotic)
+                hops = self.route(kind="exotic")
             except NoRouteError as exc:
                 last_exc = exc
                 if blacklist_cleared:
@@ -674,7 +724,7 @@ class PipelineClient:
                 last_exc = exc
                 self._blame_chain_failure(hops, exc)
                 try:
-                    new_hops = self.route(exotic=exotic)
+                    new_hops = self.route(kind="exotic")
                     self._replay_chain(new_hops, session_id, sampling,
                                        max_length)
                 except NoRouteError as rexc:
@@ -738,9 +788,16 @@ class PipelineClient:
         session_id = session_id or f"sess-{time.monotonic_ns():x}"
         prompt_len = len(prompt_ids)
         # Session kind is fixed at entry: a speculative session's PREFILL
-        # must already avoid batched peers (they refuse draft steps), and a
-        # plain session prefers them.
-        exotic = speculative_k > 0
+        # must already avoid single-session engines (they refuse draft
+        # steps); a plain session prefers batched peers; a long-context
+        # session prefers sp peers (prefix KV sharded across their mesh).
+        if speculative_k > 0:
+            kind = "exotic"
+        elif (self.long_context_threshold is not None
+              and prompt_len >= self.long_context_threshold):
+            kind = "long"
+        else:
+            kind = "plain"
         max_length = max_length or (
             prompt_len + max_new_tokens
             + (speculative_k if speculative_k > 0 else 0))
@@ -760,7 +817,7 @@ class PipelineClient:
             s0_resp.hidden, prompt_len, 0, session_id,
             is_prefill=True, max_length=max_length, sampling=sampling,
             generated=generated, step_seed=self.seed, stage_times=times,
-            exotic=exotic,
+            kind=kind, min_context=max_length,
         )
         ttft = time.monotonic() - t0
         self.last_prefill_stage_times = times
@@ -807,7 +864,7 @@ class PipelineClient:
                 stage_times=times,
                 draft_tokens=drafts if drafts else None,
                 start_from_position=spos,
-                exotic=exotic,
+                kind=kind, min_context=max_length,
             )
             accepted = list(resp.tokens) if drafts else [resp.token_id]
             if drafts:
@@ -902,7 +959,7 @@ class PipelineClient:
         resp = self._walk(
             s0_resp.hidden, prompt_len, 0, session_id, is_prefill=True,
             max_length=max_length, num_logprobs=topn, stage_times=times,
-            exotic=True,
+            kind="exotic",
         )
         ttft = time.monotonic() - t0
         self.last_prefill_stage_times = times
@@ -946,7 +1003,7 @@ class PipelineClient:
             resp = self._walk(
                 s0_resp.hidden, 1, cur_len, session_id,
                 is_prefill=False, max_length=max_length, num_logprobs=topn,
-                hypo_ids=hypo, stage_times=times, exotic=True,
+                hypo_ids=hypo, stage_times=times, kind="exotic",
             )
             self.decode_stage_history.append(times)
             cur_len += 1

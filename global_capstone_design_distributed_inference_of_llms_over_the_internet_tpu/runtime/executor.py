@@ -125,11 +125,35 @@ class StageExecutor:
         max_chunk_bytes: int = 256 * 1024 * 1024,
         offload: bool = False,
         keep_layers_resident: int = 0,
+        tp_mesh: Optional["jax.sharding.Mesh"] = None,
+        tp_axis: str = "tp",
     ):
         self.cfg = cfg
         self.spec = spec
         self.params = params
         self.peer_id = peer_id
+        # Tensor parallelism INSIDE the serving path (the reference wraps
+        # every serving block in TP, petals/server/backend.py:43): params are
+        # megatron-sharded over the local ('tp',) mesh, the step runs through
+        # parallel.tensor_parallel's shard_map, and the session KV shards
+        # over kv heads. Protocol-invisible: requests/responses are
+        # replicated at the boundary.
+        self.tp_mesh = tp_mesh
+        self.tp_axis = tp_axis
+        if tp_mesh is not None:
+            from ..parallel.tensor_parallel import (
+                shard_stage_params,
+                validate_tp,
+            )
+
+            if offload:
+                raise ValueError(
+                    "tensor parallelism and host offload are mutually "
+                    "exclusive on one executor (a TP span is HBM-resident "
+                    "by design)")
+            validate_tp(cfg, tp_mesh.shape[tp_axis])
+            self.params = params = shard_stage_params(
+                cfg, params, tp_mesh, tp_axis)
         # Prefill chunk budget (petals ``backend.py:129-143``
         # max_chunk_size_bytes): long prefills run as several bounded chunks
         # over the same session cache instead of one huge activation.
@@ -150,12 +174,21 @@ class StageExecutor:
                 lambda a: jax.device_put(a, host), params)
             params = self.params
         self.cache_dtype = jnp.dtype(cache_dtype)
+        kv_sharding = None
+        tp_degree = 1
+        if tp_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            kv_sharding = NamedSharding(tp_mesh, P(None, None, None, tp_axis))
+            tp_degree = tp_mesh.shape[tp_axis]
         self.arena = arena or KVArena(
             num_layers=max(spec.num_layers, 1),
             num_kv_heads=cfg.num_kv_heads,
             head_dim=cfg.head_dim,
             max_bytes=max_cache_bytes,
             dtype=cache_dtype,
+            sharding=kv_sharding,
+            bytes_divisor=tp_degree,
         )
         self.debug_activation_checks = debug_activation_checks
         self.requests_served = 0
@@ -212,6 +245,13 @@ class StageExecutor:
                 cfg, sub_spec, sub_params,
                 keep_resident=self.keep_layers_resident,
             )
+        elif self.tp_mesh is not None:
+            from ..parallel.tensor_parallel import make_tp_stage_fn
+
+            step = make_tp_stage_fn(
+                cfg, sub_spec, self.tp_mesh, self.tp_axis,
+                donate_cache=True,
+            )(sub_params)
         else:
             @partial(jax.jit, donate_argnums=(2, 3))
             def step(params, x, k_cache, v_cache, cache_len):

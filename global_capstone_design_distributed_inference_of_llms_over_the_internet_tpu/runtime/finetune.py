@@ -236,10 +236,10 @@ class DistributedFineTuner:
 
     def _step_once(self, ids: jnp.ndarray, targets: jnp.ndarray,
                    refresh_route: bool) -> float:
-        # exotic=True: training verbs (train_forward/backward) only exist on
-        # per-session executors — a batched peer in the route would fail
-        # every step (batched engines serve plain inference only).
-        hops = self.client.route(refresh=refresh_route, exotic=True)
+        # kind="exotic": training verbs (train_forward/backward) only exist
+        # on per-session executors — a batched/sp peer in the route would
+        # fail every step (those engines serve plain inference only).
+        hops = self.client.route(refresh=refresh_route, kind="exotic")
         self._session_n += 1
         session_id = f"ft-{id(self):x}-{self._session_n}"
         tr = self.trainables

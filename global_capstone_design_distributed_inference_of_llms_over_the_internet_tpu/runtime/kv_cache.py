@@ -116,6 +116,8 @@ class KVArena:
         buckets: Tuple[int, ...] = DEFAULT_BUCKETS,
         alloc_timeout: float = 10.0,
         device: Optional[jax.Device] = None,
+        sharding: Optional[jax.sharding.Sharding] = None,
+        bytes_divisor: int = 1,
     ):
         self.num_layers = num_layers
         self.num_kv_heads = num_kv_heads
@@ -125,6 +127,14 @@ class KVArena:
         self.buckets = tuple(sorted(buckets))
         self.alloc_timeout = alloc_timeout
         self.device = device
+        # Tensor-parallel arenas: buffers are placed with `sharding` (KV
+        # shards over kv heads, tensor_parallel.init_tp_kv layout) and the
+        # byte accounting divides by `bytes_divisor` (= tp degree) — the
+        # budget is PER-DEVICE HBM, the unit an operator actually has,
+        # mirroring the reference's TP-aware cache sizing
+        # (petals/server/server.py:280-293).
+        self.sharding = sharding
+        self.bytes_divisor = max(int(bytes_divisor), 1)
 
         self._lock = threading.Condition()
         self._used_bytes = 0
@@ -139,9 +149,11 @@ class KVArena:
 
     def bytes_for(self, bucket_len: int, num_layers: Optional[int] = None,
                   batch: int = 1) -> int:
+        """PER-DEVICE bytes of one lease (total / bytes_divisor under TP)."""
         layers = self.num_layers if num_layers is None else num_layers
         per_token = 2 * layers * self.num_kv_heads * self.head_dim
-        return per_token * bucket_len * self.dtype.itemsize * batch
+        total = per_token * bucket_len * self.dtype.itemsize * batch
+        return total // self.bytes_divisor
 
     @property
     def used_bytes(self) -> int:
@@ -154,8 +166,9 @@ class KVArena:
     def tokens_left(self) -> int:
         """Advertised capacity (the DHT's ``cache_tokens_left``,
         ``petals/server/server.py:721``)."""
-        per_token = 2 * self.num_layers * self.num_kv_heads * self.head_dim
-        return max(0, self.bytes_left) // (per_token * self.dtype.itemsize)
+        per_token = (2 * self.num_layers * self.num_kv_heads * self.head_dim
+                     * self.dtype.itemsize) // self.bytes_divisor
+        return max(0, self.bytes_left) // max(per_token, 1)
 
     # -- allocation ---------------------------------------------------------
 
@@ -205,7 +218,10 @@ class KVArena:
             shape = (layers, batch, bucket_len, self.num_kv_heads, self.head_dim)
             k = jnp.zeros(shape, self.dtype)
             v = jnp.zeros(shape, self.dtype)
-            if self.device is not None:
+            if self.sharding is not None:
+                k = jax.device_put(k, self.sharding)
+                v = jax.device_put(v, self.sharding)
+            elif self.device is not None:
                 k = jax.device_put(k, self.device)
                 v = jax.device_put(v, self.device)
         except BaseException:

@@ -1292,7 +1292,7 @@ def check_direct_reachability(transport: TcpTransport, registry,
 
 _REC_FIELDS = ("peer_id", "start_block", "end_block", "throughput", "state",
                "final_stage", "stage_index", "cache_tokens_left", "address",
-               "next_server_rtts", "model", "engine")
+               "next_server_rtts", "model", "engine", "max_context")
 
 
 def _rec_to_dict(rec: ServerRecord) -> dict:
@@ -1439,11 +1439,13 @@ class RemoteRegistry:
         return self._local.get(peer_id)
 
     def discover_stage(self, stage_index: int, exclude=(), model=None,
-                       prefer_engine=None, avoid_engine=None):
+                       prefer_engine=None, avoid_engine=None,
+                       min_context=None):
         self._refresh()
         return self._local.discover_stage(stage_index, exclude, model=model,
                                           prefer_engine=prefer_engine,
-                                          avoid_engine=avoid_engine)
+                                          avoid_engine=avoid_engine,
+                                          min_context=min_context)
 
     def discover_block(self, block: int, exclude=(), model=None):
         self._refresh()

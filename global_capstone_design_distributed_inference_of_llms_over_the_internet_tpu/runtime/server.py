@@ -111,6 +111,7 @@ def derive_num_blocks(
     attn_cache_bytes: int = 1 << 30,
     device=None,
     headroom_fraction: float = 0.15,
+    tp: int = 1,
 ) -> Optional[int]:
     """Server auto-capacity: how many blocks fit THIS device's free memory
     after the KV arena and an activation-headroom reserve — the reference's
@@ -139,8 +140,12 @@ def derive_num_blocks(
     free = max(0, int(limit) - int(stats.get("bytes_in_use", 0) or 0))
     from ..models.quant import block_bytes
 
-    usable = int(free * (1.0 - headroom_fraction)) - attn_cache_bytes
-    per = block_bytes(cfg, dtype_bytes, quant)
+    # TP shards each block's weights AND its KV arena share over tp devices,
+    # so the per-DEVICE cost divides by tp (the reference's TP-aware sizing,
+    # petals/server/server.py:280-293) — an N-chip host serves ~N× blocks.
+    tp = max(int(tp), 1)
+    usable = int(free * (1.0 - headroom_fraction)) - attn_cache_bytes // tp
+    per = max(block_bytes(cfg, dtype_bytes, quant) // tp, 1)
     if usable < per:
         # The reference raises when even one block does not fit
         # (server.py:275-326); choose_num_blocks floors at 1, which here
@@ -151,15 +156,18 @@ def derive_num_blocks(
             f"{attn_cache_bytes / 2**30:.2f} GiB, block="
             f"{per / 2**30:.2f} GiB (pass --num_blocks to override, or "
             "shrink the arena / use --quant)")
+    # free*tp is per-device math folded into choose_num_blocks' total-budget
+    # form: (tp*free*(1-r) - attn) / block == (free*(1-r) - attn/tp) / (block/tp).
     n = choose_num_blocks(
-        cfg, free, dtype_bytes=dtype_bytes, quant=quant,
+        cfg, free * tp, dtype_bytes=dtype_bytes, quant=quant,
         attn_cache_bytes=attn_cache_bytes,
         reserve_fraction=headroom_fraction,
     )
     logger.info(
-        "auto num_blocks=%d (free=%.2f GiB of %.2f GiB, arena=%.2f GiB, "
-        "quant=%s, %.0f%% headroom)", n, free / 2**30, int(limit) / 2**30,
-        attn_cache_bytes / 2**30, quant, headroom_fraction * 100)
+        "auto num_blocks=%d (free=%.2f GiB of %.2f GiB per device, tp=%d, "
+        "arena=%.2f GiB, quant=%s, %.0f%% headroom)", n, free / 2**30,
+        int(limit) / 2**30, tp, attn_cache_bytes / 2**30, quant,
+        headroom_fraction * 100)
     return n
 
 

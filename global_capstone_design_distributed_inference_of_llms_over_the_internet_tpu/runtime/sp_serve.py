@@ -1,0 +1,205 @@
+"""Serve a sequence-parallel stage behind the StageRequest protocol.
+
+VERDICT r2 item 4: `parallel.sp_stage.SpStageRunner` (prefix KV sharded
+along the sequence axis of a local ("sp",) mesh — P devices hold P× the
+context at the same per-device HBM) existed with tests and dryrun coverage
+but no serve-mode wiring. This adapter is the missing piece: a drop-in
+executor for `TcpStageServer`, so `--mode serve --sp N` gives a deployment
+real long-context capacity.
+
+Capability contract (SURVEY.md §5.7 — the exceed-the-reference axis): the
+reference's only long-context mechanism is single-server chunked prefill
+(``petals/server/backend.py:129-143``); its KV must fit one machine. Here a
+prompt bigger than one device's KV budget prefills across the mesh.
+
+Scope mirrors `BatchingStageAdapter`'s single-purpose design: ONE live
+session at a time (a long-context session monopolizes the mesh's HBM by
+construction), plain prefill/decode only; everything else is refused with a
+retryable stage error so clients route it to a per-session replica. The
+client routes sessions here via kind="long" (engine="sp" registry
+preference, `runtime.client` route kinds).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.sp_stage import SpStageRunner
+
+__all__ = ["SpStageAdapter"]
+
+
+class _SpArenaView:
+    """KVArena-shaped facade (tokens_left only): remaining admission
+    headroom of the CURRENT session, or the full max_context when idle.
+
+    Bounded lock wait: forward() holds the adapter lock across whole
+    prefill/decode dispatches (including compiles), and the caller here is
+    the HEARTBEAT thread — blocking it past the registry TTL would expire a
+    healthy server. A busy adapter returns the last known value instead."""
+
+    def __init__(self, adapter: "SpStageAdapter"):
+        self._adapter = adapter
+        self._last = adapter.max_context
+
+    def tokens_left(self) -> int:
+        a = self._adapter
+        if a._lock.acquire(timeout=0.5):
+            try:
+                self._last = (a.max_context if a._session is None
+                              else max(0, a.max_context - a.runner.cache_len))
+            finally:
+                a._lock.release()
+        return self._last
+
+
+class SpStageAdapter:
+    engine = "sp"   # registry capability tag (ServerRecord.engine)
+
+    def __init__(self, runner: SpStageRunner, *, peer_id: str = "sp",
+                 max_context: Optional[int] = None):
+        self.runner = runner
+        self.spec = runner.spec
+        self.cfg = runner.cfg
+        self.peer_id = peer_id
+        # Advertised admission limit: prompt + generated tokens. The prefix
+        # shards over p devices, so the natural ceiling scales with the mesh;
+        # the generation tail is bounded separately by the runner's tail_max.
+        self.max_context = max_context or (
+            runner.p * 8192 + runner.tail_max)
+        self.requests_served = 0
+        self._session: Optional[str] = None
+        self._lock = threading.Lock()
+        self.arena = _SpArenaView(self)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Pre-compile prefill (one ragged shape re-specializes per prompt
+        length — jit handles that) and the decode step."""
+        first = self.spec.is_first
+        d = self.cfg.hidden_size
+        t = 2 * self.runner.p
+        x = (np.zeros((1, t), np.int32) if first
+             else np.zeros((1, t, d), np.float32))
+        self.runner.prefill(x)
+        step = (np.zeros((1, 1), np.int32) if first
+                else np.zeros((1, 1, d), np.float32))
+        self.runner.decode(jnp.asarray(step))
+        self.runner.reset()
+
+    def drop_session(self, session_id: str) -> None:
+        with self._lock:
+            if self._session == session_id:
+                self._session = None
+                self.runner.reset()
+
+    # -- protocol ----------------------------------------------------------
+
+    def forward(self, req) -> "StageResponse":
+        from .executor import StageExecutionError
+
+        self.requests_served += 1
+        if (req.train or req.hypo_ids is not None or req.num_logprobs
+                or req.draft_tokens is not None or req.is_replay
+                or req.start_from_position not in (None, req.cur_len)):
+            raise StageExecutionError(
+                "sp peer serves plain prefill/decode only "
+                "(route beam/speculative/replay to a per-session replica)")
+        if req.start_block is not None and (
+                req.start_block != self.spec.start
+                or (req.end_block or self.spec.end) != self.spec.end):
+            raise StageExecutionError("sp peer serves its full span only")
+        if req.seq_len + req.cur_len > self.max_context:
+            raise StageExecutionError(
+                f"session {req.session_id}: {req.cur_len}+{req.seq_len} "
+                f"tokens > sp max_context {self.max_context}")
+        with self._lock:
+            if req.is_prefill:
+                if self._session not in (None, req.session_id):
+                    # One long-context session owns the mesh at a time; a
+                    # retryable refusal lets the client fail over / wait.
+                    raise StageExecutionError(
+                        f"sp peer busy with session {self._session}")
+                return self._prefill(req)
+            if self._session != req.session_id:
+                raise StageExecutionError(
+                    f"session {req.session_id}: decode without a live sp "
+                    "session (prefill first; replay-rebuild is per-session "
+                    "only)")
+            return self._decode(req)
+
+    # -- phases (caller holds the lock) ------------------------------------
+
+    def _wrap(self, fn, *args):
+        from .executor import StageExecutionError
+
+        try:
+            return fn(*args)
+        except StageExecutionError:
+            raise
+        except Exception as exc:
+            # Same taxonomy as the batched adapter: a failed dispatch must
+            # cross the wire as a retryable stage error, and the session
+            # state must not linger half-built.
+            self._session = None
+            self.runner.reset()
+            raise StageExecutionError(str(exc)) from exc
+
+    def _respond(self, req, hidden, position: int):
+        from .executor import _sample_last
+        from .messages import StageResponse
+
+        cache_len = self.runner.cache_len
+        if self.spec.is_last:
+            logits = self.runner.logits_at(hidden, position)[:, None]  # [B,1,V]
+            token = _sample_last(logits, 1, req)
+            return StageResponse(session_id=req.session_id, token_id=token,
+                                 cache_len=cache_len)
+        return StageResponse(session_id=req.session_id, hidden=hidden,
+                             cache_len=cache_len)
+
+    def _prefill(self, req):
+        from .executor import StageExecutionError
+
+        if req.hidden.shape[0] != 1:
+            raise StageExecutionError("sp serving is batch-1 (long-context "
+                                      "sessions monopolize the mesh)")
+        # Generated tokens land in the REPLICATED tail cache, which is
+        # hard-capped at tail_max — admit the whole declared session budget
+        # NOW, or a permitted generation dies mid-decode at step tail_max
+        # (the runner's 'tail cache full' error is not retryable anywhere:
+        # replaying a long-context journal into a refusing peer kills the
+        # generation).
+        budget = req.max_length - req.seq_len
+        if budget > self.runner.tail_max:
+            raise StageExecutionError(
+                f"session {req.session_id}: max_length {req.max_length} "
+                f"implies {budget} generated tokens > sp tail capacity "
+                f"{self.runner.tail_max}")
+        h = self._wrap(self.runner.prefill, req.hidden)
+        self._session = req.session_id
+        if self.spec.is_last:
+            return self._respond(req, h, req.seq_len - 1)
+        from .messages import StageResponse
+
+        return StageResponse(session_id=req.session_id, hidden=h,
+                             cache_len=self.runner.cache_len)
+
+    def _decode(self, req):
+        from .executor import StageExecutionError
+
+        if req.seq_len != 1:
+            raise StageExecutionError(
+                "sp decode is single-token (chunked continuation belongs to "
+                "the per-session executor)")
+        if req.cur_len != self.runner.cache_len:
+            raise StageExecutionError(
+                f"session {req.session_id}: cur_len {req.cur_len} != server "
+                f"{self.runner.cache_len} (stale retry?)")
+        h = self._wrap(self.runner.decode, req.hidden)
+        return self._respond(req, h, 0)

@@ -68,6 +68,10 @@ class ServerRecord:
     # reference's serving runtime is batch-first throughout
     # (petals/server/server.py:557-671).
     engine: str = "session"
+    # engine="sp": the advertised long-context admission limit (prompt +
+    # generated tokens) — prefix KV shards across the server's mesh, so this
+    # scales with its device count. None for other engines.
+    max_context: Optional[int] = None
     stage_index: Optional[int] = None      # fixed-split mode stage number
     cache_tokens_left: Optional[int] = None  # petals/server/server.py:721
     address: Optional[str] = None          # "host:port" for the TCP data plane
@@ -170,20 +174,30 @@ class PlacementRegistry:
                        exclude: Sequence[str] = (),
                        model: Optional[str] = None,
                        prefer_engine: Optional[str] = None,
-                       avoid_engine: Optional[str] = None) -> Optional[str]:
+                       avoid_engine=None,
+                       min_context: Optional[int] = None) -> Optional[str]:
         """Pick a server for a fixed-split stage: random among the 5 newest
         live candidates, excluding known-failed peers
         (``src/rpc_transport.py:270-353``). `prefer_engine` narrows to that
-        engine when any such candidate exists (soft); `avoid_engine` drops
-        those candidates unless nothing else remains (a session that a
-        batched peer would refuse should not be routed to one)."""
+        engine when any such candidate exists (soft); `avoid_engine` (one
+        name or a sequence) drops those candidates unless nothing else
+        remains (a session that a batched/sp peer would refuse should not be
+        routed to one)."""
         cands = [
             r for r in self._live(model=model)
             if r.stage_index == stage_index and r.peer_id not in exclude
             and r.state == ServerState.ONLINE
         ]
+        if min_context is not None:
+            # An sp peer advertising less context than the session needs
+            # WILL refuse its prefill — hard-drop those.
+            cands = [r for r in cands
+                     if r.engine != "sp" or r.max_context is None
+                     or r.max_context >= min_context]
         if avoid_engine is not None:
-            kept = [r for r in cands if r.engine != avoid_engine]
+            avoid = ((avoid_engine,) if isinstance(avoid_engine, str)
+                     else tuple(avoid_engine))
+            kept = [r for r in cands if r.engine not in avoid]
             if kept:
                 cands = kept
         if prefer_engine is not None:

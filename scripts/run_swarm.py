@@ -56,6 +56,15 @@ def main():
                         "engine (--mode serve --batched)")
     p.add_argument("--slots", type=int, default=8,
                    help="--batched: concurrent sessions per server")
+    p.add_argument("--tp", type=int, default=1,
+                   help="fixed-split servers shard their stage over a "
+                        "local ('tp',) mesh of N devices")
+    p.add_argument("--sp", type=int, default=1,
+                   help="fixed-split servers run sequence-parallel "
+                        "long-context serving over N devices")
+    p.add_argument("--device_count", type=int, default=None,
+                   help="force N virtual CPU devices per process "
+                        "(xla_force_host_platform_device_count)")
     args = p.parse_args()
 
     num_stages = len(args.splits.split(","))  # stages 1..N (0 = client)
@@ -70,6 +79,17 @@ def main():
         # the registration entirely (local CPU compiles) — overriding any
         # inherited pool config, since the subprocesses are CPU-only here.
         env["PALLAS_AXON_POOL_IPS"] = ""
+    device_count = args.device_count
+    if (device_count is None and env.get("JAX_PLATFORMS") == "cpu"
+            and max(args.tp, args.sp) > 1):
+        # --tp/--sp servers need that many devices; a CPU swarm has one
+        # unless we force virtual devices — without this every server exits
+        # at startup and readiness never arrives.
+        device_count = max(args.tp, args.sp)
+    if device_count:
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count="
+                            f"{device_count}").strip()
     procs = []
 
     def spawn(role_args, log_name):
@@ -111,6 +131,10 @@ def main():
                 role += ["--stage", str(i)]
                 if args.batched:
                     role += ["--batched", "--slots", str(args.slots)]
+                if args.tp > 1:
+                    role += ["--tp", str(args.tp)]
+                if args.sp > 1:
+                    role += ["--sp", str(args.sp)]
             spawn(common + role, f"stage{i}")
 
         # Readiness = every server's record is live AND ONLINE in the

@@ -189,8 +189,8 @@ def test_plain_route_prefers_batched_replica(batched_swarm):
     reg_server.registry.register(rec)
 
     client, tx = _make_client(cfg, params, plan, reg_server.address, "route")
-    plain = client.route(exotic=False)
-    exotic = client.route(exotic=True)
+    plain = client.route(kind="plain")
+    exotic = client.route(kind="exotic")
     assert plain[-1].peer_id == "bat-s2"
     assert exotic[-1].peer_id == "sess-s2"
     # Both kinds actually generate, token-identical to the oracle.
@@ -228,9 +228,9 @@ def test_module_routing_filters_batched_subspan():
     client = PipelineClient(cfg, plan, None, _NullTransport(), registry,
                             use_module_routing=True, total_blocks=6,
                             settle_seconds=0.0)
-    plain = client.route(exotic=False)
+    plain = client.route(kind="plain")
     assert [h.peer_id for h in plain] == ["bat"]  # full-span batched, preferred
-    exotic = client.route(exotic=True)
+    exotic = client.route(kind="exotic")
     assert [h.peer_id for h in exotic] == ["sess"]
 
 
