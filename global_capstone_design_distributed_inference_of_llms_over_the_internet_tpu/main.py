@@ -26,7 +26,9 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import random
+import re
 import sys
 import time
 from typing import List, Optional, Sequence, Tuple
@@ -80,6 +82,31 @@ def load_tokenizer(checkpoint: Optional[str]):
     return ByteTokenizer()
 
 
+_STORES: dict = {}
+
+
+def _remote_store(args):
+    """Memoized RemoteShardStore for an http(s):// --checkpoint (one cache
+    + one LRU state per process, shared by load_model and _stage_params)."""
+    from .models.remote_store import RemoteShardStore
+
+    key = (args.checkpoint, args.weight_cache_dir)
+    store = _STORES.get(key)
+    if store is None:
+        cache = args.weight_cache_dir or os.path.join(
+            os.path.expanduser("~"), ".cache", "mini_petals_tpu",
+            re.sub(r"[^A-Za-z0-9._-]+", "_", args.checkpoint))
+        store = RemoteShardStore(
+            args.checkpoint, cache,
+            max_cache_bytes=args.weight_cache_bytes)
+        _STORES[key] = store
+    return store
+
+
+def _is_remote(checkpoint) -> bool:
+    return bool(checkpoint) and checkpoint.startswith(("http://", "https://"))
+
+
 def load_model(args) -> Tuple[ModelConfig, dict]:
     if args.dtype == "float16":
         # TPUs have no fp16 compute path; bf16 differs numerically (8-bit
@@ -87,10 +114,24 @@ def load_model(args) -> Tuple[ModelConfig, dict]:
         # reproduce bit-for-bit.
         logger.warning("--dtype float16 runs as bfloat16 on TPU")
     dtype = _DTYPE_MAP[args.dtype]
+    if _is_remote(args.checkpoint):
+        from .models.hf_import import config_from_checkpoint
+
+        store = _remote_store(args)
+        cfg = config_from_checkpoint(store.fetch_config())
+        if args.mode in ("local", "serve", "client"):
+            # Per-span streaming (petals from_pretrained.py:81-128): params
+            # stay None; each serving role later fetches just the shards
+            # covering ITS span (store.load_stage via _stage_params).
+            return cfg, None
+        # oracle/fused/etc. need the FULL tree up front: fetch every shard,
+        # then stream-convert from the cache like a local checkpoint.
+        from .models.partition import ROLE_FULL, StageSpec
+
+        full = StageSpec(0, ROLE_FULL, 0, cfg.num_layers)
+        return cfg, store.load_stage(cfg, full, dtype=dtype)
     if args.checkpoint:
         if args.mode in ("local", "serve", "client"):
-            import os
-
             from .models.hf_import import config_from_checkpoint
 
             has_st = (os.path.exists(os.path.join(
@@ -370,7 +411,11 @@ def run_oracle(args, cfg: ModelConfig, params) -> int:
 
 def _generate_and_report(args, generate_fn, cfg: ModelConfig,
                          supports_speculative: bool = True) -> int:
-    tokenizer = load_tokenizer(args.checkpoint)
+    # Remote checkpoints: the tokenizer files were fetched into the local
+    # cache by fetch_config — load from there, not the URL.
+    tokenizer = load_tokenizer(_remote_store(args).cache_dir
+                               if _is_remote(args.checkpoint)
+                               else args.checkpoint)
     prompt_ids = tokenizer.encode(args.prompt)
     prompt_ids = [i % cfg.vocab_size for i in prompt_ids]
     sampling = SamplingParams(
@@ -415,10 +460,14 @@ def _stage_params(args, cfg: ModelConfig, params, spec):
     checkpoint when possible, sliced from the loaded tree otherwise, then
     optionally block-quantized (--quant int8, V9 parity)."""
     if params is None:
-        from .models.hf_import import load_stage_checkpoint
+        if _is_remote(args.checkpoint):
+            sp = _remote_store(args).load_stage(
+                cfg, spec, dtype=_DTYPE_MAP[args.dtype])
+        else:
+            from .models.hf_import import load_stage_checkpoint
 
-        sp = load_stage_checkpoint(args.checkpoint, cfg, spec,
-                                   dtype=_DTYPE_MAP[args.dtype])
+            sp = load_stage_checkpoint(args.checkpoint, cfg, spec,
+                                       dtype=_DTYPE_MAP[args.dtype])
     else:
         sp = slice_stage_params(cfg, params, spec)
     if getattr(args, "quant", "none") != "none":
@@ -739,7 +788,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "models can share one registry without cross-routing "
                         "when every server/client passes its own name.")
     p.add_argument("--checkpoint", default=None,
-                   help="local HF checkpoint dir (offline); omit for random init")
+                   help="local HF checkpoint dir, or an http(s):// weight "
+                        "store (an HF checkpoint layout behind any static "
+                        "file server) — servers then fetch ONLY the shards "
+                        "covering their span; omit for random init")
+    p.add_argument("--weight_cache_dir", default=None,
+                   help="remote --checkpoint: local shard cache directory")
+    p.add_argument("--weight_cache_bytes", type=int, default=None,
+                   help="remote --checkpoint: LRU-evict cached shards "
+                        "beyond this many bytes")
     p.add_argument("--splits", default=None,
                    help='stage boundaries, e.g. "10,20,30" (reference format)')
     p.add_argument("--stage", type=int, default=0,

@@ -1,0 +1,292 @@
+"""Remote per-span weight fetch with a bounded, digest-verified disk cache.
+
+VERDICT r2 item 5 / reference parity: Petals servers download ONLY the
+checkpoint shards containing their span's parameters and manage/evict the
+disk cache (``petals/server/from_pretrained.py:81-128`` — per-block shard
+filtering against the HF index; ``:189-213`` — free-space-driven cache
+eviction). This module is the TPU-build equivalent over a plain HTTP store
+(any static file server; a local fixture in tests — this sandbox has zero
+egress, but the capability is the contract):
+
+  * the store layout is exactly an HF checkpoint directory: ``config.json``,
+    ``model.safetensors.index.json`` (or a single ``model.safetensors``),
+    shard files, and optionally ``digests.json`` ({filename: sha256});
+  * ``shards_for_span`` filters the index's weight_map to the files covering
+    ``[start, end)`` for a stage role — the reference's ``block_prefix``
+    filter generalized to span + role (embed/head);
+  * fetched shards land in a local cache directory with LRU accounting; once
+    the cache exceeds ``max_cache_bytes``, least-recently-USED shards not
+    needed by the current span are deleted (an elastic re-span keeps only
+    what it still serves);
+  * every fetched file is sha256-verified against ``digests.json`` when the
+    store publishes one — a truncated/corrupted download fails loudly, never
+    parses.
+
+``load_stage`` then defers to the local streaming path
+(``hf_import.LazyCheckpoint``/``convert_state_dict``) over the cache dir, so
+remote and local checkpoints share one conversion code path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional, Set, Tuple
+
+from .config import ModelConfig
+
+logger = logging.getLogger(__name__)
+
+INDEX = "model.safetensors.index.json"
+SINGLE = "model.safetensors"
+DIGESTS = "digests.json"
+
+# Layer-scoped key patterns across supported families (hf_import layouts),
+# with and without the base-model prefix (LazyCheckpoint alias rule).
+_LAYER_RE = re.compile(
+    r"^(?:transformer\.)?h\.(\d+)\.|^(?:model\.)?layers\.(\d+)\.")
+
+
+def _layer_of(key: str) -> Optional[int]:
+    m = _LAYER_RE.match(key)
+    if m is None:
+        return None
+    return int(m.group(1) if m.group(1) is not None else m.group(2))
+
+
+class DigestMismatch(RuntimeError):
+    """A fetched shard's sha256 does not match the store's digests.json."""
+
+
+class RemoteShardStore:
+    """Span-scoped shard fetcher over HTTP with a bounded LRU disk cache."""
+
+    def __init__(self, base_url: str, cache_dir: str,
+                 max_cache_bytes: Optional[int] = None,
+                 timeout: float = 60.0):
+        self.base_url = base_url.rstrip("/")
+        self.cache_dir = cache_dir
+        self.max_cache_bytes = max_cache_bytes
+        self.timeout = timeout
+        os.makedirs(cache_dir, exist_ok=True)
+        self.fetches: List[str] = []     # every remote GET, in order (tests)
+        self._digests: Optional[Dict[str, str]] = None
+        self._weight_map: Optional[Dict[str, str]] = None
+        # One lock serializes fetch/evict/load within the process: a store
+        # is memoized and shared by every serving role (elastic servers
+        # re-span on background threads), and thread A's eviction must not
+        # delete shards thread B fetched but has not read yet. Cross-process
+        # sharers of one cache dir are protected by the eviction GRACE
+        # period below (files younger than evict_grace_s are never evicted),
+        # which covers the other process's fetch->read window.
+        self._op_lock = threading.RLock()
+        self.evict_grace_s = 300.0
+        # filename -> last-use monotonic time; persisted so LRU survives
+        # server restarts (the reference tracks blocks via file atime).
+        self._state_path = os.path.join(cache_dir, ".lru_state.json")
+        try:
+            with open(self._state_path) as f:
+                self._lru: Dict[str, float] = dict(json.load(f))
+        except (OSError, ValueError):
+            self._lru = {}
+
+    # -- transport ---------------------------------------------------------
+
+    def _get(self, name: str) -> bytes:
+        url = f"{self.base_url}/{name}"
+        self.fetches.append(name)
+        with urllib.request.urlopen(url, timeout=self.timeout) as r:
+            return r.read()
+
+    def _fetch_to_cache(self, name: str, verify: bool = True) -> str:
+        """Download `name` into the cache (skipping if present), verify its
+        digest, bump its LRU stamp, and return the local path."""
+        local = os.path.join(self.cache_dir, name)
+        if not os.path.exists(local):
+            data = self._get(name)
+            if verify:
+                want = self.digests().get(name)
+                if want is not None:
+                    got = hashlib.sha256(data).hexdigest()
+                    if got != want:
+                        raise DigestMismatch(
+                            f"{name}: sha256 {got} != published {want}")
+            # Per-process temp name + atomic rename: several server
+            # processes legitimately share one cache dir (a multi-stage
+            # host), and two concurrent fetchers of the same shard must not
+            # interleave writes into one temp file. Either winner's bytes
+            # are digest-identical.
+            tmp = f"{local}.part.{os.getpid()}"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, local)  # never a torn shard under its name
+            finally:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+        self._touch(name)
+        return local
+
+    # -- store metadata ----------------------------------------------------
+
+    def digests(self) -> Dict[str, str]:
+        if self._digests is None:
+            try:
+                self._digests = json.loads(self._get(DIGESTS))
+            except OSError:
+                logger.warning("store publishes no %s; shards are fetched "
+                               "UNVERIFIED", DIGESTS)
+                self._digests = {}
+        return self._digests
+
+    def weight_map(self) -> Dict[str, str]:
+        """key -> shard filename (downloads the index, small)."""
+        if self._weight_map is not None:
+            return self._weight_map
+        try:
+            local = self._fetch_to_cache(INDEX)
+            with open(local) as f:
+                self._weight_map = dict(json.load(f)["weight_map"])
+        except OSError:
+            # Single-file checkpoint: every key lives in model.safetensors.
+            self._fetch_to_cache(SINGLE)
+            from safetensors import safe_open
+
+            with safe_open(os.path.join(self.cache_dir, SINGLE),
+                           framework="flax") as f:
+                self._weight_map = {k: SINGLE for k in f.keys()}
+        return self._weight_map
+
+    # Tokenizer files a checkpoint MAY publish (best-effort: absence is
+    # normal; clients fall back to the byte tokenizer only when none load).
+    TOKENIZER_FILES = ("tokenizer.json", "tokenizer_config.json",
+                       "special_tokens_map.json", "tokenizer.model",
+                       "vocab.json", "merges.txt")
+
+    def fetch_config(self) -> str:
+        """Fetch config.json + any published tokenizer files; returns the
+        cache dir, which is then a loadable local checkpoint prefix."""
+        self._fetch_to_cache("config.json")
+        for name in self.TOKENIZER_FILES:
+            try:
+                self._fetch_to_cache(name)
+            except OSError:
+                pass
+        return self.cache_dir
+
+    # -- span logic --------------------------------------------------------
+
+    def shards_for_span(self, start: int, end: int, *, is_first: bool,
+                        is_last: bool) -> List[str]:
+        """Shard files containing any parameter the span's role needs — the
+        per-block filter of ``from_pretrained.py:100-108`` over [start,end)."""
+        needed: Set[str] = set()
+        for key, fname in self.weight_map().items():
+            layer = _layer_of(key)
+            if layer is not None:
+                if start <= layer < end:
+                    needed.add(fname)
+            elif is_first or is_last:
+                # Non-layer tensors: embeddings (first), final norm + head
+                # (last). Embeddings also serve tied heads; fetching the
+                # handful of non-layer tensors for either boundary role is
+                # exact enough at shard granularity.
+                needed.add(fname)
+        return sorted(needed)
+
+    def ensure_span(self, start: int, end: int, *, is_first: bool,
+                    is_last: bool) -> List[str]:
+        """Fetch (or reuse) every shard the span needs; evict LRU excess
+        beyond the byte budget. Returns the local shard paths."""
+        with self._op_lock:
+            names = self.shards_for_span(start, end, is_first=is_first,
+                                         is_last=is_last)
+            paths = [self._fetch_to_cache(n) for n in names]
+            self._evict(keep=set(names))
+            return paths
+
+    def load_stage(self, cfg: ModelConfig, spec, dtype=None):
+        """Fetch the span's shards then stream-convert them via the local
+        per-stage path (one conversion code path for local + remote).
+
+        Holds the op lock across fetch AND convert so a concurrent span's
+        eviction cannot delete these shards between download and read."""
+        import numpy as np
+
+        from .hf_import import load_stage_checkpoint
+
+        with self._op_lock:
+            self.fetch_config()
+            self.ensure_span(spec.start, spec.end, is_first=spec.is_first,
+                             is_last=spec.is_last)
+            return load_stage_checkpoint(self.cache_dir, cfg, spec,
+                                         dtype=dtype or np.float32)
+
+    # -- cache management --------------------------------------------------
+
+    def _touch(self, name: str) -> None:
+        self._lru[name] = time.monotonic()
+        try:
+            with open(self._state_path, "w") as f:
+                json.dump(self._lru, f)
+        except OSError:  # pragma: no cover — cache still works, LRU degrades
+            pass
+
+    def cache_bytes(self) -> int:
+        total = 0
+        for fname in os.listdir(self.cache_dir):
+            if fname.endswith(".safetensors"):
+                total += os.path.getsize(os.path.join(self.cache_dir, fname))
+        return total
+
+    def _evict(self, keep: Set[str]) -> None:
+        """Delete least-recently-used shards (never `keep` — the span being
+        served) until the cache fits the budget
+        (``from_pretrained.py:189-213`` semantics)."""
+        if self.max_cache_bytes is None:
+            return
+        excess = self.cache_bytes() - self.max_cache_bytes
+        if excess <= 0:
+            return
+        now = time.time()
+        cands = []
+        for f in os.listdir(self.cache_dir):
+            if not f.endswith(".safetensors") or f in keep:
+                continue
+            try:
+                age = now - os.path.getmtime(os.path.join(self.cache_dir, f))
+            except OSError:
+                continue
+            # Grace period: a file another PROCESS just fetched (sharing
+            # this cache dir) is still inside its fetch->read window; its
+            # recency is visible to us only via mtime.
+            if age < self.evict_grace_s:
+                continue
+            cands.append(f)
+        cands.sort(key=lambda f: self._lru.get(f, 0.0))
+        for fname in cands:
+            if excess <= 0:
+                break
+            path = os.path.join(self.cache_dir, fname)
+            try:
+                size = os.path.getsize(path)
+                os.remove(path)
+            except OSError:
+                continue
+            self._lru.pop(fname, None)
+            excess -= size
+            logger.info("evicted cached shard %s (%.1f MiB)", fname,
+                        size / 2**20)
+        if excess > 0:
+            # The CURRENT span alone exceeds the budget: serve it anyway
+            # (evicting it would break the server), but say so.
+            logger.warning(
+                "weight cache over budget by %.1f MiB even after eviction "
+                "(the current span needs more than max_cache_bytes)",
+                excess / 2**20)
