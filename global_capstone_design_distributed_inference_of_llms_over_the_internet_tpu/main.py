@@ -849,10 +849,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batched", action="store_true",
                    help="serve mode: continuous slot-batched engine — "
                         "concurrent plain sessions coalesce into ONE "
-                        "compiled decode step per round; advertised as "
-                        "engine=batched so clients route plain sessions "
-                        "here and beam/speculative/replay to per-session "
-                        "replicas")
+                        "compiled decode step per round (speculative "
+                        "draft steps coalesce too, as multi-token verify "
+                        "rounds); advertised as engine=batched so clients "
+                        "route plain and speculative sessions here and "
+                        "beam/replay to per-session replicas")
     p.add_argument("--slots", type=int, default=8,
                    help="serve --batched: max concurrent sessions")
     p.add_argument("--max_session_len", type=int, default=2048,

@@ -18,9 +18,13 @@ ready — inactive slots are masked), and leave via `end_session` (slot
 recycled). Token parity with the per-session oracle is asserted in
 tests/test_batching.py.
 
-Scope: the batched path covers plain greedy/sampled decode. Beam reorder,
-speculative drafts, and replay ride the per-session StageExecutor —
-servers route those requests to it unchanged.
+Scope: the batched path covers plain greedy/sampled decode AND speculative
+verification — a draft step is rows of [last_accepted, d_1..d_K], i.e. a
+multi-token batched forward plus per-row accept/reject, so spec sessions
+coalesce the same way plain ones do (rounds are keyed by step width T; all
+requests in a round share one compiled step). Beam reorder, training, and
+replay still ride the per-session StageExecutor — servers route those
+requests to it unchanged.
 """
 
 from __future__ import annotations
@@ -78,7 +82,7 @@ class BatchedStageExecutor:
         self._free: List[int] = list(range(slots))
         self.decode_steps = 0                          # batched steps executed
         self._prefill_jit = None
-        self._decode_jit = None
+        self._decode_jits: Dict[int, Any] = {}         # step width T -> jit
 
     # ------------------------------------------------------------------
     # Slots
@@ -102,6 +106,20 @@ class BatchedStageExecutor:
         if s is not None:
             self.lengths[s] = 0
             self._free.append(s)
+
+    def rewind(self, session_id: str, pos: int) -> None:
+        """Shrink a session's valid KV prefix to `pos` (the
+        ``start_from_position`` semantics of petals handler.py:163-168,
+        reused as speculative rollback). Host-side only: rows past `pos`
+        are never attended (the decode mask allows positions <= length)
+        and are overwritten as the session advances."""
+        s = self._slot_of.get(session_id)
+        if s is None:
+            raise KeyError(f"unknown session {session_id}")
+        if not 0 <= pos <= int(self.lengths[s]):
+            raise ValueError(
+                f"rewind to {pos} outside [0, {int(self.lengths[s])}]")
+        self.lengths[s] = pos
 
     # ------------------------------------------------------------------
     # Prefill: per-session, writes the prompt's KV into the slot's rows
@@ -218,19 +236,32 @@ class BatchedStageExecutor:
     # Batched decode: one step for EVERY active slot
     # ------------------------------------------------------------------
 
-    def _build_decode(self):
+    def _build_decode(self, t_step: int):
+        """One batched step of `t_step` tokens per active slot. t_step == 1
+        is plain decode; t_step == K+1 is a speculative verify round (the
+        draft block enters as new tokens, causal within itself)."""
         cfg, spec = self.cfg, self.spec
         S = self.slots
+        T = t_step
 
         @partial(jax.jit, donate_argnums=(4, 5))
         def fn(params, x, lengths, active, k_all, v_all):
-            # x: ids [S, 1] or hidden [S, 1, D]; lengths/active: [S].
-            positions = lengths[:, None]                       # [S, 1]
+            # x: ids [S, T] or hidden [S, T, D]; lengths/active: [S].
+            offs = jnp.arange(T, dtype=jnp.int32)
+            positions = lengths[:, None] + offs[None, :]       # [S, T]
             h = (embed_tokens(cfg, params["embed"], x, positions)
                  if spec.is_first else x)
             rope = make_rope(cfg, positions)
             groups = cfg.num_heads // cfg.num_kv_heads
             pos_grid = jnp.arange(k_all.shape[2], dtype=jnp.int32)  # [max_len]
+            # allowed[s, tq, m]: key position m visible to query token tq of
+            # slot s — everything up to and including the query's own
+            # position (causal within the new block too).
+            qpos = positions[:, :, None]                        # [S, T, 1]
+            allowed = pos_grid[None, None, :] <= qpos           # [S, T, M]
+            if cfg.sliding_window:
+                # Window spans (qpos - window, qpos].
+                allowed &= pos_grid[None, None, :] > qpos - cfg.sliding_window
 
             def layer(h, lp_kv):
                 lp, (k_l, v_l) = lp_kv                 # k_l: [S,max_len,Hkv,Dh]
@@ -238,16 +269,17 @@ class BatchedStageExecutor:
 
                 lp = dequant_tree(lp)
                 a = _norm(cfg, lp["ln1"], h)
-                q, k, v = qkv_proj(cfg, lp["attn"], a)     # [S,1,H/Hkv,Dh]
+                q, k, v = qkv_proj(cfg, lp["attn"], a)     # [S,T,H/Hkv,Dh]
                 if rope is not None:
                     q = apply_rope(q, *rope)
                     k = apply_rope(k, *rope)
-                # Per-slot cache write at each slot's own length (vmap'd
-                # dynamic_update_slice). Inactive slots write their OWN
-                # current row back: a slot parked at max_len would clamp its
-                # start to max_len-1 and clobber that session's last real KV
-                # row, so the write value for inactive slots is the row
-                # already there (one-row gather — cheaper than a full-cache
+                # Per-slot cache write of T rows at each slot's own length
+                # (vmap'd dynamic_update_slice). Inactive slots write their
+                # OWN current rows back: a slot parked near max_len would
+                # clamp its start and clobber that session's last real KV
+                # rows, so the write value for inactive slots is the rows
+                # already there (read and write clamp to the SAME start, so
+                # the round trip is a no-op — cheaper than a full-cache
                 # select on the donated buffers).
                 upd = jax.vmap(
                     lambda cache, new, start, act:
@@ -255,30 +287,23 @@ class BatchedStageExecutor:
                         cache,
                         jnp.where(
                             act, new,
-                            jax.lax.dynamic_slice_in_dim(cache, start, 1, 0)),
+                            jax.lax.dynamic_slice_in_dim(cache, start, T, 0)),
                         start, 0)
                 )
                 k_l = upd(k_l, k.astype(k_l.dtype), lengths, active)
                 v_l = upd(v_l, v.astype(v_l.dtype), lengths, active)
-                # Attention over [0, length] (inclusive of the new token).
-                qg = q.reshape(S, 1, cfg.num_kv_heads, groups, cfg.head_dim)
+                # Attention over [0, query position] per new token.
+                qg = q.reshape(S, T, cfg.num_kv_heads, groups, cfg.head_dim)
                 scores = jnp.einsum(
                     "bthgd,bshd->bhgts", qg * cfg.head_dim ** -0.5,
                     k_l.astype(q.dtype),
-                    preferred_element_type=jnp.float32)      # [S,Hkv,G,1,M]
-                allowed = pos_grid[None, :] <= lengths[:, None]   # [S, M]
-                if cfg.sliding_window:
-                    # Query position is lengths[s]; window spans
-                    # (pos - window, pos].
-                    allowed &= (pos_grid[None, :]
-                                > lengths[:, None] - cfg.sliding_window)
-                scores = jnp.where(allowed[:, None, None, None], scores,
-                                   NEG_INF)
+                    preferred_element_type=jnp.float32)      # [S,Hkv,G,T,M]
+                scores = jnp.where(allowed[:, None, None], scores, NEG_INF)
                 probs = jax.nn.softmax(scores, axis=-1)
                 out = jnp.einsum("bhgts,bshd->bthgd",
                                  probs.astype(v_l.dtype),
                                  v_l.astype(q.dtype))
-                out = out.reshape(S, 1, -1) @ lp["attn"]["wo"]
+                out = out.reshape(S, T, -1) @ lp["attn"]["wo"]
                 if "bo" in lp["attn"]:
                     out = out + lp["attn"]["bo"]
                 h = h + out
@@ -305,38 +330,48 @@ class BatchedStageExecutor:
                          for s in occupied))
 
     def decode_batch(self, inputs: Dict[str, Any]) -> Dict[str, jnp.ndarray]:
-        """One batched step. inputs: {session_id: ids [1,1] or hidden
-        [1,1,D]}. Returns {session_id: hidden [1,1,D]}. Sessions not in
-        `inputs` are untouched (masked)."""
+        """One batched step. inputs: {session_id: ids [1,T] or hidden
+        [1,T,D]} — every session in the call shares one step width T (T=1
+        plain decode, T=K+1 speculative verify). Returns {session_id:
+        hidden [1,T,D]}. Sessions not in `inputs` are untouched (masked)."""
         if not inputs:
             return {}
         sids = list(inputs)
+        t = int(np.asarray(inputs[sids[0]]).shape[1])
         rows = []
         for sid in sids:
+            if int(np.asarray(inputs[sid]).shape[1]) != t:
+                raise ValueError(
+                    "all sessions in one batched step share one width "
+                    f"(got {np.asarray(inputs[sid]).shape[1]} vs {t})")
             if sid not in self._slot_of:
                 raise KeyError(f"unknown session {sid} (prefill first)")
-            if self.lengths[self._slot_of[sid]] >= self.max_len:
-                raise RuntimeError(f"session {sid} at max_len {self.max_len}")
+            if self.lengths[self._slot_of[sid]] + t > self.max_len:
+                raise RuntimeError(
+                    f"session {sid}: {t} tokens past length "
+                    f"{int(self.lengths[self._slot_of[sid]])} exceeds "
+                    f"max_len {self.max_len}")
             rows.append(self._slot_of[sid])
 
         first = self.spec.is_first
         d = self.cfg.hidden_size
         if first:
-            x = np.zeros((self.slots, 1), np.int32)
+            x = np.zeros((self.slots, t), np.int32)
         else:
-            x = np.zeros((self.slots, 1, d), np.float32)
+            x = np.zeros((self.slots, t, d), np.float32)
         for sid, s in zip(sids, rows):
             x[s] = np.asarray(inputs[sid])[0]
         active = np.zeros((self.slots,), bool)
         active[rows] = True
 
-        if self._decode_jit is None:
-            self._decode_jit = self._build_decode()
-        h, self.k, self.v = self._decode_jit(
+        step = self._decode_jits.get(t)
+        if step is None:
+            step = self._decode_jits[t] = self._build_decode(t)
+        h, self.k, self.v = step(
             self.params, jnp.asarray(x), jnp.asarray(self.lengths),
             jnp.asarray(active), self.k, self.v)
         for s in rows:
-            self.lengths[s] += 1
+            self.lengths[s] += t
         self.decode_steps += 1
         return {sid: h[s:s + 1] for sid, s in zip(sids, rows)}
 
@@ -356,14 +391,18 @@ class BatchedStageExecutor:
 
 class _Round:
     """One coalescing window: requests that arrive while it is open share a
-    single batched step."""
+    single batched step. Rounds are keyed by step width T (seq_len), so a
+    round's sessions always share one compiled step: T=1 plain decode,
+    T=K+1 speculative verify."""
 
-    __slots__ = ("reqs", "outs", "err", "bad", "lengths", "event", "closed")
+    __slots__ = ("reqs", "outs", "err", "bad", "lengths", "spec", "event",
+                 "closed")
 
     def __init__(self):
         self.reqs: Dict[str, Any] = {}
         self.outs: Dict[str, jnp.ndarray] = {}
         self.lengths: Dict[str, int] = {}
+        self.spec: Dict[str, Tuple[Tuple[int, ...], int]] = {}  # verified rows
         self.err: Optional[Exception] = None      # whole-round failure
         self.bad: Dict[str, str] = {}             # per-session exclusions
         self.event = threading.Event()
@@ -396,13 +435,16 @@ class _SlotArenaView:
 
 class BatchingStageAdapter:
     """Drop-in StageExecutor replacement for transports: plain
-    prefill/decode requests ride the batched engine, with concurrent decode
-    calls coalesced — the FIRST arrival leads the round, waits
-    ``window_s`` for followers, runs ONE `decode_batch`, and every waiter
-    picks up its own row. Beam/speculative/training/replay/sub-span
-    requests are refused with a retryable stage error so clients route them
-    to a per-session replica (the batched path is the common-case fast
-    lane, not the whole protocol — see module docstring)."""
+    prefill/decode AND speculative-verify requests ride the batched engine,
+    with concurrent decode calls coalesced — the FIRST arrival leads its
+    width's round, waits ``window_s`` for followers, runs ONE
+    `decode_batch`, and every waiter picks up its own row. Draft steps
+    (width K+1) coalesce with each other; the final stage verifies each
+    row and rewinds its slot past the rejected tail before releasing
+    waiters. Beam/training/replay/sub-span requests are refused with a
+    retryable stage error so clients route them to a per-session replica
+    (the batched path is the common-case fast lane, not the whole protocol
+    — see module docstring)."""
 
     engine = "batched"   # registry capability tag (ServerRecord.engine)
 
@@ -417,7 +459,7 @@ class BatchingStageAdapter:
         self.step_timeout = step_timeout
         self.requests_served = 0
         self._lock = threading.Lock()
-        self._round: Optional[_Round] = None
+        self._rounds: Dict[int, _Round] = {}   # step width T -> open round
         # TcpStageServer's info verb + heartbeat read `.arena.tokens_left()`
         # on whatever executor they serve; point that surface at the slot
         # tables so a batched server advertises real admission headroom.
@@ -444,11 +486,12 @@ class BatchingStageAdapter:
 
         self.requests_served += 1
         if (req.train or req.hypo_ids is not None or req.num_logprobs
-                or req.draft_tokens is not None or req.is_replay
+                or req.is_replay
                 or req.start_from_position not in (None, req.cur_len)):
             raise StageExecutionError(
-                "batched peer serves plain prefill/decode only "
-                "(route beam/speculative/replay to a per-session replica)")
+                "batched peer serves plain prefill/decode and speculative "
+                "verify only (route beam/training/replay to a per-session "
+                "replica)")
         if req.start_block is not None and (
                 req.start_block != self.spec.start
                 or (req.end_block or self.spec.end) != self.spec.end):
@@ -456,7 +499,12 @@ class BatchingStageAdapter:
                 "batched peer serves its full span only")
         if req.is_prefill:
             return self._prefill(req)
-        if req.seq_len != 1:
+        if req.draft_tokens is not None:
+            if req.seq_len != len(req.draft_tokens) + 1:
+                raise StageExecutionError(
+                    f"speculative step carries {req.seq_len} positions for "
+                    f"{len(req.draft_tokens)} drafts (want K+1)")
+        elif req.seq_len != 1:
             raise StageExecutionError(
                 "batched decode is single-token (chunked continuation "
                 "belongs to the per-session executor)")
@@ -506,8 +554,20 @@ class BatchingStageAdapter:
             return (f"session {req.session_id}: decode without a slot "
                     "(prefill first; replay-rebuild is per-session only)")
         cur = int(self.inner.lengths[s])
-        if cur >= self.inner.max_len:
-            return f"session {req.session_id} at max_len {self.inner.max_len}"
+        spos = req.start_from_position
+        if spos is not None and spos != cur:
+            # Speculative rollback: the previous round's rejected overhang
+            # is still in the slot; shrink the valid prefix before this
+            # round appends (petals start_from_position semantics —
+            # forward() already pinned spos == req.cur_len).
+            if spos > cur:
+                return (f"session {req.session_id}: rewind to {spos} beyond "
+                        f"cache {cur}")
+            self.inner.rewind(req.session_id, spos)
+            cur = spos
+        if cur + req.seq_len > self.inner.max_len:
+            return (f"session {req.session_id}: {req.seq_len} tokens past "
+                    f"{cur} exceeds max_len {self.inner.max_len}")
         if req.cur_len != cur:
             # The per-session executor warns and trusts itself
             # (executor.py past-len mismatch); the batched path REFUSES: the
@@ -521,15 +581,17 @@ class BatchingStageAdapter:
 
     def _decode(self, req):
         from .executor import StageExecutionError
+        from .messages import StageResponse
 
         sid = req.session_id
+        t = req.seq_len
         with self._lock:
             reason = self._validate(req)
             if reason is not None:
                 raise StageExecutionError(reason)
-            r = self._round
+            r = self._rounds.get(t)
             if r is None or r.closed:
-                r = self._round = _Round()
+                r = self._rounds[t] = _Round()
                 leader = True       # explicit: whoever CREATES the round
             else:
                 leader = False
@@ -541,8 +603,8 @@ class BatchingStageAdapter:
             time.sleep(self.window_s)
             with self._lock:
                 r.closed = True
-                if self._round is r:
-                    self._round = None
+                if self._rounds.get(t) is r:
+                    del self._rounds[t]
                 # Re-validate under the lock: a session may have been
                 # dropped (or otherwise invalidated) since it joined.
                 # Exclusions fail ONLY their own waiter.
@@ -557,6 +619,8 @@ class BatchingStageAdapter:
                     if good:
                         r.outs = self.inner.decode_batch(
                             {s_id: rq.hidden for s_id, rq in good.items()})
+                        if self.spec.is_last:
+                            self._verify_spec_rows(r, good)
                         r.lengths = {
                             s_id: int(self.inner.lengths[self.inner.slot(s_id)])
                             for s_id in good
@@ -570,4 +634,34 @@ class BatchingStageAdapter:
             raise StageExecutionError(str(r.err)) from r.err
         if sid in r.bad:
             raise StageExecutionError(r.bad[sid])
+        if sid in r.spec:
+            tokens, n_acc = r.spec[sid]
+            return StageResponse(session_id=sid, tokens=tokens,
+                                 n_accepted=n_acc, cache_len=r.lengths[sid])
         return self._respond(req, r.outs[sid], r.lengths[sid])
+
+    def _verify_spec_rows(self, r: _Round, good: Dict[str, Any]) -> None:
+        """Per-row speculative verification on the final stage (caller holds
+        the lock, the round's batched step has run): compute each draft
+        session's logits over its K+1 positions, accept/reject with the
+        SAME math as the per-session executor
+        (executor.verify_drafts_from_logits), and rewind the slot past the
+        rejected tail so the next round's cur_len validates against the
+        accepted prefix."""
+        from .executor import verify_drafts_from_logits
+
+        spec_ids = [s_id for s_id, rq in good.items()
+                    if rq.draft_tokens is not None]
+        if not spec_ids:
+            return
+        # ONE stacked head projection for the whole round ([n, T, D] ->
+        # [n, T, V]) — a per-session loop of [1, T, D] head calls would
+        # undo the round's batching and stretch the lock hold linearly
+        # with slot count.
+        stacked = jnp.concatenate([r.outs[s_id] for s_id in spec_ids], axis=0)
+        logits = self.inner.logits(stacked)
+        for i, s_id in enumerate(spec_ids):
+            rq = good[s_id]
+            tokens, n_acc = verify_drafts_from_logits(logits[i], rq)
+            self.inner.rewind(s_id, rq.cur_len + n_acc + 1)
+            r.spec[s_id] = (tokens, n_acc)

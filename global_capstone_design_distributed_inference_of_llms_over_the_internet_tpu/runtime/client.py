@@ -51,9 +51,11 @@ REPEAT_STOP = 5           # 5 consecutive identical tokens, src/main.py:197-204
 MAX_COALESCED_TOKENS = 4096
 
 
-# Engines that serve plain prefill/decode of their FULL span only: they
-# refuse beam/speculative/training/replay and sub-span requests, so exotic
-# sessions and replay-failover must route around them.
+# Engines that serve prefill/decode of their FULL span only: they refuse
+# beam/training/replay and sub-span requests, so exotic sessions and
+# replay-failover must route around them. Speculative draft steps are the
+# exception: batched peers verify drafts in-round (batching.py), so
+# kind="spec" sessions route TO them; sp peers still refuse drafts.
 SESSION_ONLY_ENGINES = ("batched", "sp")
 
 
@@ -67,9 +69,15 @@ def _engine_usable(rec, kind: str, full_span: bool = True,
         return False
     if (min_context is not None and rec.max_context is not None
             and rec.max_context < min_context):
-        # An sp peer advertising a smaller context than this session needs
+        # A peer advertising a smaller context than this session needs
         # WILL refuse the prefill — don't route there just to bounce.
+        # Applies to every kind, spec included (a batched peer's slots
+        # have a max_len too).
         return False
+    if kind == "spec":
+        # Draft steps batch on batched peers (multi-token verify rounds,
+        # batching.py); sp peers refuse them.
+        return rec.engine == "batched"
     return True
 
 
@@ -222,11 +230,14 @@ class PipelineClient:
         # Route cache per session KIND:
         #   "plain"  — prefers engine=batched peers (one compiled step
         #              serves every concurrent session);
+        #   "spec"   — speculative sessions: prefers batched peers too
+        #              (draft verify coalesces in multi-token rounds) but
+        #              must avoid sp peers, which refuse drafts;
         #   "long"   — prefers engine=sp peers (prefix KV sharded across a
         #              mesh: context beyond one device's budget);
-        #   "exotic" — beam / speculative / training / anything the
-        #              single-session engines refuse (batching.py:387-407)
-        #              routes around them.
+        #   "exotic" — beam / training / anything the single-session
+        #              engines refuse (batching.py forward checks) routes
+        #              around them.
         # Keyed so kinds never evict each other's route.
         self._routes: Dict[str, List[Hop]] = {}
         # peer -> (rtt_s, measured_at): client-side ping cache for the
@@ -256,8 +267,10 @@ class PipelineClient:
             exclude = self.failed_peers.get(key, set())
             peer = self.registry.discover_stage(
                 spec.index, exclude=tuple(exclude), model=self.model,
-                prefer_engine={"plain": "batched", "long": "sp"}.get(kind),
-                avoid_engine=SESSION_ONLY_ENGINES if kind == "exotic" else None,
+                prefer_engine={"plain": "batched", "spec": "batched",
+                               "long": "sp"}.get(kind),
+                avoid_engine=(SESSION_ONLY_ENGINES if kind == "exotic"
+                              else ("sp",) if kind == "spec" else None),
                 min_context=min_context)
             if peer is None:
                 raise NoRouteError(f"no live server for {key}")
@@ -308,6 +321,15 @@ class PipelineClient:
             # latency, and engine preference is secondary there).
             records = _soft_filter(
                 records, lambda r: r.engine not in SESSION_ONLY_ENGINES)
+        elif kind == "spec":
+            # Batched peers verify drafts; sp peers refuse them. A peer
+            # advertising less context than the session needs would refuse
+            # the prefill.
+            records = _soft_filter(
+                records,
+                lambda r: r.engine != "sp" and (
+                    min_context is None or r.max_context is None
+                    or r.max_context >= min_context))
         elif min_context is not None:
             # sp peers advertising less context than this session needs
             # would refuse the prefill.
@@ -382,7 +404,8 @@ class PipelineClient:
                                          min_context=min_context))
             if not cands:
                 raise NoRouteError(f"no live server covers block {covered}")
-            prefer = {"plain": "batched", "long": "sp"}.get(kind)
+            prefer = {"plain": "batched", "spec": "batched",
+                      "long": "sp"}.get(kind)
             best = max(cands, key=lambda c: (
                 c.end_block,
                 c.engine == prefer,    # engine preference on equal coverage
@@ -788,11 +811,12 @@ class PipelineClient:
         session_id = session_id or f"sess-{time.monotonic_ns():x}"
         prompt_len = len(prompt_ids)
         # Session kind is fixed at entry: a speculative session's PREFILL
-        # must already avoid single-session engines (they refuse draft
-        # steps); a plain session prefers batched peers; a long-context
+        # must already land on a peer that will take its draft steps
+        # (batched peers verify drafts in coalesced rounds; sp peers refuse
+        # them); a plain session prefers batched peers; a long-context
         # session prefers sp peers (prefix KV sharded across their mesh).
         if speculative_k > 0:
-            kind = "exotic"
+            kind = "spec"
         elif (self.long_context_threshold is not None
               and prompt_len >= self.long_context_threshold):
             kind = "long"

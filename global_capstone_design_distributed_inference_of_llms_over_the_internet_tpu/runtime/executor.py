@@ -63,6 +63,47 @@ class StageExecutionError(RuntimeError):
     ``src/rpc_handler.py:198-202`` for decode-without-cache)."""
 
 
+def verify_drafts_from_logits(
+    logits2d: jnp.ndarray, req: StageRequest
+) -> "tuple[tuple[int, ...], int]":
+    """Final-stage speculative verification over one session's logits.
+
+    logits2d: [T, V] for the T = K+1 positions [last_accepted, d_1..d_K];
+    logits2d[i] predicts the token AFTER consuming position i. Returns
+    (tokens, n_accepted) with len(tokens) == n_accepted + 1 (accepted run
+    plus one correction/bonus token). Shared by the per-session executor
+    and the batched adapter so both engines verify identically.
+
+    Greedy (temperature<=0): accept while d_{i+1} == argmax(logits[i]) —
+    token-identical to non-speculative greedy decoding
+    (``src/rpc_handler.py:334-335`` applies greedy before penalties).
+    Sampled (temperature>0): rejection-sampling verification
+    (ops.sampling.speculative_verify) — accept draft i with probability
+    p_i(d_i), resample the residual on reject — which preserves the
+    sampling distribution exactly."""
+    drafts = np.asarray(req.draft_tokens, np.int64)
+    k = int(drafts.shape[0])
+    if not req.sampling.greedy:
+        from ..ops.sampling import speculative_verify
+
+        recent = np.zeros((RECENT_WINDOW,), np.int32)
+        n = min(len(req.generated_tokens), RECENT_WINDOW)
+        if n:
+            recent[:n] = np.asarray(req.generated_tokens[-n:], np.int32)
+        sp = req.sampling
+        toks, n_acc = speculative_verify(
+            jax.random.PRNGKey(req.step_seed),
+            logits2d.astype(jnp.float32),
+            [int(d) for d in drafts], recent, n,
+            sp.temperature, sp.top_p, sp.top_k, sp.repetition_penalty)
+        return tuple(int(t) for t in toks), int(n_acc)
+    preds = np.asarray(jnp.argmax(logits2d, axis=-1))  # [T]
+    n_acc = 0
+    while n_acc < k and int(preds[n_acc]) == int(drafts[n_acc]):
+        n_acc += 1
+    return tuple(int(t) for t in preds[: n_acc + 1]), n_acc
+
+
 def _sample_rows(logits: jnp.ndarray, t_real: int, req: StageRequest) -> np.ndarray:
     """Final-stage sampling from the last REAL token's logits, PER BATCH ROW,
     using the metadata-shipped params + recent window
@@ -505,8 +546,7 @@ class StageExecutor:
         sampling distribution exactly, so temperature>0 gets the same
         round-trip amortization.
         """
-        drafts = np.asarray(req.draft_tokens, np.int64)
-        k = int(drafts.shape[0])
+        k = len(req.draft_tokens)
         t_real = req.seq_len
         if t_real != k + 1:
             raise StageExecutionError(
@@ -514,33 +554,7 @@ class StageExecutor:
                 "(want K+1)"
             )
         logits = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
-        if not req.sampling.greedy:
-            from ..ops.sampling import speculative_verify
-
-            recent = np.zeros((RECENT_WINDOW,), np.int32)
-            n = min(len(req.generated_tokens), RECENT_WINDOW)
-            if n:
-                recent[:n] = np.asarray(req.generated_tokens[-n:], np.int32)
-            sp = req.sampling
-            toks, n_acc = speculative_verify(
-                jax.random.PRNGKey(req.step_seed),
-                logits[0].astype(jnp.float32),
-                [int(d) for d in drafts], recent, n,
-                sp.temperature, sp.top_p, sp.top_k, sp.repetition_penalty)
-            tokens = tuple(int(t) for t in toks)
-            valid = req.cur_len + n_acc + 1
-            try:
-                handle.rewind(valid)
-            except ValueError as exc:  # pragma: no cover - defensive
-                raise StageExecutionError(str(exc)) from exc
-            return StageResponse(
-                session_id=req.session_id, tokens=tokens, n_accepted=n_acc,
-                cache_len=handle.cache_len)
-        preds = np.asarray(jnp.argmax(logits[0], axis=-1))  # [T]
-        n_acc = 0
-        while n_acc < k and int(preds[n_acc]) == int(drafts[n_acc]):
-            n_acc += 1
-        tokens = tuple(int(t) for t in preds[: n_acc + 1])
+        tokens, n_acc = verify_drafts_from_logits(logits[0], req)
         # Rewind our own cache: positions for rejected drafts are garbage.
         valid = req.cur_len + n_acc + 1
         try:
