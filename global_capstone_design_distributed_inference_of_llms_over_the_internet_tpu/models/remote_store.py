@@ -87,7 +87,10 @@ class RemoteShardStore:
         # which covers the other process's fetch->read window.
         self._op_lock = threading.RLock()
         self.evict_grace_s = 300.0
-        # filename -> last-use monotonic time; persisted so LRU survives
+        # filename -> last-use WALL-CLOCK time (time.time, not monotonic:
+        # stamps are persisted and compared across restarts/boots, and a
+        # boot-relative clock would sort post-reboot touches BELOW ancient
+        # pre-reboot ones, inverting eviction); persisted so LRU survives
         # server restarts (the reference tracks blocks via file atime).
         self._state_path = os.path.join(cache_dir, ".lru_state.json")
         try:
@@ -117,12 +120,13 @@ class RemoteShardStore:
                     if got != want:
                         raise DigestMismatch(
                             f"{name}: sha256 {got} != published {want}")
-            # Per-process temp name + atomic rename: several server
-            # processes legitimately share one cache dir (a multi-stage
-            # host), and two concurrent fetchers of the same shard must not
-            # interleave writes into one temp file. Either winner's bytes
-            # are digest-identical.
-            tmp = f"{local}.part.{os.getpid()}"
+            # Per-process-AND-thread temp name + atomic rename: several
+            # server processes legitimately share one cache dir (a
+            # multi-stage host) and several threads of one process share
+            # the store, and no two concurrent fetchers of the same shard
+            # may interleave writes into one temp file. Either winner's
+            # bytes are digest-identical.
+            tmp = f"{local}.part.{os.getpid()}.{threading.get_ident()}"
             try:
                 with open(tmp, "wb") as f:
                     f.write(data)
@@ -136,24 +140,50 @@ class RemoteShardStore:
     # -- store metadata ----------------------------------------------------
 
     def digests(self) -> Dict[str, str]:
-        if self._digests is None:
-            try:
-                self._digests = json.loads(self._get(DIGESTS))
-            except OSError:
-                logger.warning("store publishes no %s; shards are fetched "
-                               "UNVERIFIED", DIGESTS)
-                self._digests = {}
-        return self._digests
+        with self._op_lock:
+            if self._digests is None:
+                import urllib.error
+
+                try:
+                    self._digests = json.loads(self._get(DIGESTS))
+                except urllib.error.HTTPError as exc:
+                    if exc.code != 404:
+                        raise
+                    # 404 is the store SAYING it publishes no digests —
+                    # cacheable. A transient transport error (timeout,
+                    # reset) propagates UN-cached: memoizing {} there would
+                    # silently disable verification for the whole process
+                    # on a store that does publish digests.
+                    logger.warning("store publishes no %s; shards are "
+                                   "fetched UNVERIFIED", DIGESTS)
+                    self._digests = {}
+            return self._digests
 
     def weight_map(self) -> Dict[str, str]:
         """key -> shard filename (downloads the index, small)."""
-        if self._weight_map is not None:
-            return self._weight_map
-        try:
-            local = self._fetch_to_cache(INDEX)
-            with open(local) as f:
-                self._weight_map = dict(json.load(f)["weight_map"])
-        except OSError:
+        with self._op_lock:
+            if self._weight_map is not None:
+                return self._weight_map
+            try:
+                local = self._fetch_to_cache(INDEX)
+                try:
+                    with open(local) as f:
+                        wm = json.load(f)["weight_map"]
+                    if not isinstance(wm, dict):
+                        raise ValueError("weight_map is not a mapping")
+                    self._weight_map = dict(wm)
+                    return self._weight_map
+                except (ValueError, KeyError):
+                    # Present-but-malformed index (e.g. a misconfigured
+                    # host answering 200 with an error page): drop the
+                    # cached copy so a retry refetches instead of failing
+                    # forever, then try the single-file layout.
+                    try:
+                        os.remove(local)
+                    except OSError:
+                        pass
+            except OSError:
+                pass
             # Single-file checkpoint: every key lives in model.safetensors.
             self._fetch_to_cache(SINGLE)
             from safetensors import safe_open
@@ -161,7 +191,7 @@ class RemoteShardStore:
             with safe_open(os.path.join(self.cache_dir, SINGLE),
                            framework="flax") as f:
                 self._weight_map = {k: SINGLE for k in f.keys()}
-        return self._weight_map
+            return self._weight_map
 
     # Tokenizer files a checkpoint MAY publish (best-effort: absence is
     # normal; clients fall back to the byte tokenizer only when none load).
@@ -172,13 +202,14 @@ class RemoteShardStore:
     def fetch_config(self) -> str:
         """Fetch config.json + any published tokenizer files; returns the
         cache dir, which is then a loadable local checkpoint prefix."""
-        self._fetch_to_cache("config.json")
-        for name in self.TOKENIZER_FILES:
-            try:
-                self._fetch_to_cache(name)
-            except OSError:
-                pass
-        return self.cache_dir
+        with self._op_lock:
+            self._fetch_to_cache("config.json")
+            for name in self.TOKENIZER_FILES:
+                try:
+                    self._fetch_to_cache(name)
+                except OSError:
+                    pass
+            return self.cache_dir
 
     # -- span logic --------------------------------------------------------
 
@@ -231,10 +262,26 @@ class RemoteShardStore:
     # -- cache management --------------------------------------------------
 
     def _touch(self, name: str) -> None:
-        self._lru[name] = time.monotonic()
+        self._lru[name] = time.time()
         try:
-            with open(self._state_path, "w") as f:
+            # Merge-on-write: other PROCESSES sharing this cache dir write
+            # their own stamps to the same file; blind-rewriting from this
+            # process's view would zero their recency and make _evict
+            # delete their in-use shards first. Newest stamp per key wins;
+            # the write itself is atomic (temp + replace).
+            try:
+                with open(self._state_path) as f:
+                    disk = dict(json.load(f))
+            except (OSError, ValueError):
+                disk = {}
+            for k, v in disk.items():
+                if isinstance(v, (int, float)) and v > self._lru.get(k, 0.0):
+                    self._lru[k] = float(v)
+            tmp = (f"{self._state_path}.part.{os.getpid()}"
+                   f".{threading.get_ident()}")
+            with open(tmp, "w") as f:
                 json.dump(self._lru, f)
+            os.replace(tmp, self._state_path)
         except OSError:  # pragma: no cover — cache still works, LRU degrades
             pass
 
