@@ -147,13 +147,15 @@ class RemoteShardStore:
                 try:
                     self._digests = json.loads(self._get(DIGESTS))
                 except urllib.error.HTTPError as exc:
-                    if exc.code != 404:
-                        raise
-                    # 404 is the store SAYING it publishes no digests —
-                    # cacheable. A transient transport error (timeout,
-                    # reset) propagates UN-cached: memoizing {} there would
+                    # 404/403/410 are the store SAYING the file is absent
+                    # (S3/GCS static hosting without list permission
+                    # answers 403 for nonexistent keys) — cacheable. A
+                    # transient transport error (timeout, reset, 5xx)
+                    # propagates UN-cached: memoizing {} there would
                     # silently disable verification for the whole process
                     # on a store that does publish digests.
+                    if exc.code not in (404, 403, 410):
+                        raise
                     logger.warning("store publishes no %s; shards are "
                                    "fetched UNVERIFIED", DIGESTS)
                     self._digests = {}
@@ -173,11 +175,17 @@ class RemoteShardStore:
                         raise ValueError("weight_map is not a mapping")
                     self._weight_map = dict(wm)
                     return self._weight_map
-                except (ValueError, KeyError):
+                except (ValueError, KeyError) as exc:
                     # Present-but-malformed index (e.g. a misconfigured
                     # host answering 200 with an error page): drop the
                     # cached copy so a retry refetches instead of failing
-                    # forever, then try the single-file layout.
+                    # forever, then try the single-file layout. Name the
+                    # real culprit — the fallback's own failure would
+                    # otherwise blame model.safetensors.
+                    logger.warning(
+                        "%s is present but malformed (%s: %s); dropping "
+                        "the cached copy and trying the single-file "
+                        "layout", INDEX, type(exc).__name__, exc)
                     try:
                         os.remove(local)
                     except OSError:
@@ -275,6 +283,12 @@ class RemoteShardStore:
             except (OSError, ValueError):
                 disk = {}
             for k, v in disk.items():
+                if k not in self._lru and not os.path.exists(
+                        os.path.join(self.cache_dir, k)):
+                    # Evicted (by us or a co-hosted process) and no backing
+                    # file: do NOT resurrect the stamp, or the state file
+                    # grows one entry per shard ever fetched.
+                    continue
                 if isinstance(v, (int, float)) and v > self._lru.get(k, 0.0):
                     self._lru[k] = float(v)
             tmp = (f"{self._state_path}.part.{os.getpid()}"

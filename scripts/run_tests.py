@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Sharded full-suite runner: the trustworthy way to run ALL tests here.
+
+Why sharding (root cause, measured round 3): every XLA executable compiled
+in a process pins memory maps until exit; tests/conftest.py disables the
+compilation cache (determinism), so a single-process run of the full suite
+accumulates one fresh set of maps per jitted program — and deterministically
+crosses ``vm.max_map_count`` (65530 on this box) around test ~230 of ~313.
+Past ~99% of the limit, mmap failures inside XLA corrupt results or
+segfault outright (two consecutive full-suite runs segfaulted inside
+``backend_compile`` at the same collection position; a 95-test slice of the
+same files passed clean; a fresh process ballasted to 64.9k maps still
+compiled, so the kill zone is the last few hundred maps). This is also the
+measured mechanism behind the round-2 "load-correlated environmental
+corruption" flake: concurrent jobs add map churn, pulling the failure point
+earlier into the suite.
+
+The fix that needs no root and no sysctl: run the suite as a few SEQUENTIAL
+pytest processes (never parallel — one core, and concurrent compile jobs
+corrupt results), each starting from zero maps. Shards group whole files so
+cross-file imports (tests import helpers from each other) stay intact.
+
+Usage:
+    python scripts/run_tests.py            # full suite, sharded
+    python scripts/run_tests.py --durations  # + per-shard --durations=15
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Whole-file shards, grouped to keep each process's compile count (and so
+# its mmap total) far below vm.max_map_count. Order mirrors pytest's
+# alphabetical default so failures are easy to correlate.
+SHARDS = [
+    # 1: models + engines (compile-heavy parity files)
+    ["test_batch_sampling.py", "test_batching.py", "test_beam_search.py",
+     "test_checkpoint_streaming.py", "test_chunked_prefill.py",
+     "test_chunked_wire.py", "test_cli.py"],
+    # 2: distributed bring-up + elastic serving
+    ["test_dcn.py", "test_elastic_server.py", "test_finetune.py",
+     "test_fused_decode.py", "test_ici_pipeline.py", "test_kv_cache.py",
+     "test_load_balancing.py"],
+    # 3: oracles + registry + wire
+    ["test_models_oracle.py", "test_multi_model.py", "test_net.py",
+     "test_offload.py", "test_partition.py"],
+    # 4: protocol extensions
+    ["test_push_chain.py", "test_quant.py", "test_quarantine_hook.py",
+     "test_remote_store.py", "test_ring_attention.py",
+     "test_routing_rtt.py"],
+    # 5: pipeline runtime + serving engines
+    ["test_runtime_pipeline.py", "test_serve_batched.py",
+     "test_serve_sp.py", "test_serve_tp.py", "test_sp_stage.py"],
+    # 6: speculative + swarm + parallel math
+    ["test_speculative.py", "test_swarm_launcher.py", "test_task_pool.py",
+     "test_tensor_parallel.py", "test_throughput.py", "test_trainer.py"],
+]
+
+
+def main() -> int:
+    extra = []
+    if "--durations" in sys.argv:
+        extra = ["--durations=15"]
+    passthrough = [a for a in sys.argv[1:] if a != "--durations"]
+
+    t0 = time.time()
+    failures = []
+    for i, files in enumerate(SHARDS, 1):
+        missing = [f for f in files
+                   if not os.path.exists(os.path.join(REPO, "tests", f))]
+        if missing:
+            print(f"[shard {i}] MISSING test files: {missing} — update "
+                  "scripts/run_tests.py SHARDS", flush=True)
+            failures.append((i, "missing files"))
+            continue
+        cmd = [sys.executable, "-m", "pytest", "-q", *extra, *passthrough,
+               *(os.path.join("tests", f) for f in files)]
+        print(f"[shard {i}/{len(SHARDS)}] {' '.join(files)}", flush=True)
+        t = time.time()
+        r = subprocess.run(cmd, cwd=REPO)
+        print(f"[shard {i}] exit={r.returncode} in {time.time() - t:.0f}s",
+              flush=True)
+        if r.returncode != 0:
+            failures.append((i, r.returncode))
+
+    # Completeness guard: a test file added without updating SHARDS must
+    # fail the run, not silently skip.
+    sharded = {f for shard in SHARDS for f in shard}
+    on_disk = {f for f in os.listdir(os.path.join(REPO, "tests"))
+               if f.startswith("test_") and f.endswith(".py")}
+    unsharded = sorted(on_disk - sharded)
+    if unsharded:
+        print(f"UNSHARDED test files (add to SHARDS): {unsharded}")
+        failures.append(("coverage", unsharded))
+
+    total = time.time() - t0
+    if failures:
+        print(f"FULL SUITE: FAILED shards={failures} in {total:.0f}s")
+        return 1
+    print(f"FULL SUITE: all {len(SHARDS)} shards passed in {total:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

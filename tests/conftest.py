@@ -43,6 +43,27 @@ def rng():
     return np.random.default_rng(0)
 
 
+def pytest_sessionfinish(session, exitstatus):
+    # Memory-map headroom diagnostic: every compiled XLA executable pins
+    # mmaps for the life of the process, and a single-process run of the
+    # FULL suite deterministically exhausts vm.max_map_count (65530 here)
+    # around test ~230 — mmap failures inside XLA then corrupt results or
+    # segfault (measured root cause of the round-2 "environmental" flake;
+    # see scripts/run_tests.py). Print the count so every run records how
+    # close it came.
+    try:
+        with open("/proc/self/maps") as f:
+            n = sum(1 for _ in f)
+        with open("/proc/sys/vm/max_map_count") as f:
+            cap = int(f.read())
+        print(f"\n[conftest] process memory maps at exit: {n} / "
+              f"vm.max_map_count {cap}"
+              + (" — DANGER ZONE, shard this run (scripts/run_tests.py)"
+                 if n > 0.75 * cap else ""))
+    except OSError:
+        pass
+
+
 # ---------------------------------------------------------------------------
 # Smoke tier: `pytest -m smoke` runs a <2-min correctness core (oracle
 # parity, one TCP failover, one elastic re-span, KV arena + LB math) for
@@ -73,3 +94,51 @@ def pytest_collection_modifyitems(config, items):
         if mod in _SMOKE or any(rel.startswith(s) for s in _SMOKE
                                 if "::" in s):
             item.add_marker(pytest.mark.smoke)
+
+
+# ---------------------------------------------------------------------------
+# Parity-flake quarantine with teeth (VERDICT r2 item 6).
+#
+# Token-parity tests on this box occasionally fail under heavy CONCURRENT
+# host load with corrupted results — a DIFFERENT deterministic test each
+# time, never reproducible in isolation (evidence campaign: commits
+# c82adcf/8a00756; once including a segfault inside backend_compile). The
+# triage rule, mechanized: a test marked `parity` that fails is RERUN ONCE,
+# immediately, in-process. A deterministic logic bug fails both runs and the
+# suite stays red; load-induced corruption passes the rerun and the suite
+# stays trustworthy, with a loud warning recording that the environment —
+# not the engine — corrupted the first attempt.
+# ---------------------------------------------------------------------------
+
+import warnings  # noqa: E402
+
+from _pytest.runner import runtestprotocol  # noqa: E402
+
+
+def pytest_runtest_protocol(item, nextitem):
+    if item.get_closest_marker("parity") is None:
+        return None
+    item.ihook.pytest_runtest_logstart(nodeid=item.nodeid,
+                                       location=item.location)
+    reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    if any(r.failed for r in reports):
+        # Reset the fixture request before rerunning (what
+        # pytest-rerunfailures does): run 1's teardown already finalized
+        # every function-scoped fixture, and without this the rerun would
+        # receive the stale, torn-down fixture objects.
+        if hasattr(item, "_initrequest"):
+            item._initrequest()
+        rerun = runtestprotocol(item, nextitem=nextitem, log=False)
+        if not any(r.failed for r in rerun):
+            warnings.warn(
+                f"PARITY RERUN: {item.nodeid} failed once then passed "
+                "clean on immediate rerun — load-induced environmental "
+                "corruption (see tests/conftest.py quarantine note), not "
+                "an engine bug. If this recurs without concurrent load, "
+                "re-triage.")
+            reports = rerun
+    for rep in reports:
+        item.ihook.pytest_runtest_logreport(report=rep)
+    item.ihook.pytest_runtest_logfinish(nodeid=item.nodeid,
+                                        location=item.location)
+    return True

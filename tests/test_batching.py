@@ -30,6 +30,14 @@ from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.
 
 from test_runtime_pipeline import tiny_cfg
 
+# Quarantine-with-teeth (tests/conftest.py pytest_runtest_protocol): the
+# DETERMINISTIC single-threaded token-parity tests below carry
+# @pytest.mark.parity — the documented victims of load-induced host
+# corruption; a failure reruns ONCE in-process, and real logic bugs fail
+# both runs. The CONCURRENT adapter tests are deliberately NOT marked: a
+# real intermittent race there must stay a failure, not be mislabeled as
+# environmental corruption by a passing rerun.
+
 
 def full_spec(cfg):
     return StageSpec(index=0, role=ROLE_FULL, start=0, end=cfg.num_layers)
@@ -74,6 +82,7 @@ def batched_generate(ex, prompts, n_new):
 
 
 @pytest.mark.parametrize("family", ["llama", "gpt2", "qwen2"])
+@pytest.mark.parity
 def test_batched_sessions_match_per_session_oracle(family):
     cfg = tiny_cfg(family)
     params = init_params(jax.random.PRNGKey(0), cfg)
@@ -87,6 +96,7 @@ def test_batched_sessions_match_per_session_oracle(family):
     assert ex.decode_steps == n_new - 1
 
 
+@pytest.mark.parity
 def test_sessions_join_and_leave_mid_stream():
     cfg = tiny_cfg()
     params = init_params(jax.random.PRNGKey(1), cfg)
@@ -123,6 +133,7 @@ def test_sessions_join_and_leave_mid_stream():
     assert tc == rc
 
 
+@pytest.mark.parity
 def test_partial_batches_and_stragglers():
     # Sessions decode at different cadences; a step may carry any subset.
     cfg = tiny_cfg()
@@ -388,6 +399,7 @@ def test_adapter_refuses_stale_cur_len_and_round_survives():
     assert r.token_id is not None
 
 
+@pytest.mark.parity
 def test_batched_mistral_sliding_window_matches_oracle():
     """Sliding-window (Mistral) attention on the batched path: windowed
     masks in prefill and decode match the per-session oracle, with prompts
@@ -430,6 +442,7 @@ def test_prefill_failure_frees_slot():
     assert ex.slot("s2") is not None
 
 
+@pytest.mark.parity
 def test_batched_stage_pipeline_matches_oracle():
     """Two batched stage executors chained as pipeline hops: batched decode
     composes with staged serving (hidden rows flow per session)."""
@@ -460,6 +473,7 @@ def test_batched_stage_pipeline_matches_oracle():
         assert toks[sid] == oracle_tokens(cfg, params, prompt, n_new), sid
 
 
+@pytest.mark.parity
 def test_batched_mixtral_moe_matches_oracle():
     """MoE (Mixtral) on the batched path: the dense-routed expert MLP runs
     inside the slot-batched step; token parity with the per-session oracle.
@@ -488,6 +502,7 @@ def test_batched_mixtral_moe_matches_oracle():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.parity
 def test_batched_multi_token_step_and_rewind():
     """decode_batch with T>1 (the speculative verify step): a teacher-forced
     multi-token step predicts the same continuation as single-token
@@ -599,6 +614,7 @@ def test_adapter_coalesces_speculative_rounds():
     assert int(inner.lengths[inner.slot("a")]) == len(pa) + 3
 
 
+@pytest.mark.parity
 def test_client_speculative_on_batched_peer():
     """End to end: a speculative session (kind="spec") routes TO a batched
     peer, its draft rounds coalesce there, and greedy output is
@@ -657,6 +673,7 @@ def test_client_speculative_on_batched_peer():
     assert inner.decode_steps <= 3
 
 
+@pytest.mark.parity
 def test_client_speculative_sampled_batched_matches_per_session():
     """temperature>0 speculative on the batched peer: same seed + same
     drafts produce the SAME tokens as the per-session executor (the

@@ -1,0 +1,34 @@
+"""Meta-test: the parity-flake quarantine machinery itself.
+
+tests/conftest.py's ``pytest_runtest_protocol`` reruns a failed
+``parity``-marked test once, in-process — load-induced host corruption
+(the documented test_batching.py flake) passes the rerun and the suite
+stays green-and-trustworthy; a real logic bug fails both runs and the
+suite stays red. This canary FAILS ITS FIRST CALL by construction, so a
+full-suite run proves the rerun path executes (expect one loud
+"PARITY RERUN" warning naming this test — that warning is this test's
+success signature, not a problem).
+"""
+
+import pytest
+
+_calls = {"recover": 0, "plain": 0}
+
+
+@pytest.mark.parity
+def test_parity_quarantine_canary_recovers_on_rerun():
+    _calls["recover"] += 1
+    if _calls["recover"] == 1:
+        raise AssertionError(
+            "synthetic first-attempt corruption (the quarantine hook must "
+            "rerun this test; if you see this as a FAILURE the hook is "
+            "broken)")
+    assert _calls["recover"] == 2
+
+
+def test_unmarked_tests_do_not_rerun(request):
+    # The hook must scope to the parity marker: an unmarked test runs the
+    # default protocol exactly once.
+    _calls["plain"] += 1
+    assert _calls["plain"] == 1
+    assert request.node.get_closest_marker("parity") is None

@@ -319,7 +319,7 @@ def test_speculative_verify_preserves_distribution():
         jnp.asarray(temp, jnp.float32), jnp.asarray(top_p, jnp.float32),
         jnp.asarray(top_k, jnp.int32), jnp.asarray(rp, jnp.float32)))
     draft = int(np.argmax(target))          # draft the LIKELIEST token —
-    n = 4000                                # max acceptance bias if wrong
+    n = 1500                                # max acceptance bias if wrong
     counts = np.zeros(V)
     for s in range(n):
         toks, _ = speculative_verify(
@@ -327,8 +327,11 @@ def test_speculative_verify_preserves_distribution():
             temp, top_p, top_k, rp)
         counts[toks[0]] += 1
     emp = counts / n
-    # ~3 sigma for a multinomial with n=4000: ~0.024 absolute
-    np.testing.assert_allclose(emp, target, atol=0.03)
+    # ~3 sigma for a multinomial with n=1500: ~0.039 absolute. An
+    # acceptance-bias bug shifts mass by O(p_draft) ~ 0.3 — far outside
+    # this band; n=1500 keeps the check decisive at a third of the wall
+    # cost of the original n=4000 (this was the single slowest test).
+    np.testing.assert_allclose(emp, target, atol=0.045)
 
 
 def test_speculative_generation_with_sampling_runs():
