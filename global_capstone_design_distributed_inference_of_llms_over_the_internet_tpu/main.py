@@ -582,7 +582,12 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
                  keep_layers_resident=args.keep_layers_on_gpu,
                  tp_mesh=_serve_tp_mesh(args))
     logger.info("warming up stage %d (pre-compiling step shapes)", args.stage)
-    ex.warmup()
+    if args.batched and getattr(args, "speculative_k", 0):
+        # Warm the K+1-wide batched decode step too, so the first
+        # speculative round doesn't compile inside the round leader's lock.
+        ex.warmup(speculative_k=args.speculative_k)
+    else:
+        ex.warmup()
     # Per-session executors serialize compute through the prioritized
     # runtime (one compute thread owns the chip; N handler threads own the
     # sockets — the reference's handlers→Runtime split). The batched engine

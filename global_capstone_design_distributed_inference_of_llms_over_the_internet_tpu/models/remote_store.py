@@ -134,6 +134,14 @@ class RemoteShardStore:
             finally:
                 if os.path.exists(tmp):
                     os.remove(tmp)
+            # Persist after each actual DOWNLOAD (the slow path — one write
+            # per fetched shard, same IO the network dwarfs): a co-hosted
+            # process's _evict must see our recency during a long multi-
+            # shard span fetch, not only at the end. Cache hits stay
+            # in-memory-only (the fast path the batching exists for).
+            self._touch(name)
+            self._persist_lru()
+            return local
         self._touch(name)
         return local
 
@@ -147,18 +155,35 @@ class RemoteShardStore:
                 try:
                     self._digests = json.loads(self._get(DIGESTS))
                 except urllib.error.HTTPError as exc:
-                    # 404/403/410 are the store SAYING the file is absent
-                    # (S3/GCS static hosting without list permission
-                    # answers 403 for nonexistent keys) — cacheable. A
-                    # transient transport error (timeout, reset, 5xx)
-                    # propagates UN-cached: memoizing {} there would
-                    # silently disable verification for the whole process
-                    # on a store that does publish digests.
-                    if exc.code not in (404, 403, 410):
+                    # 404/410 are the store SAYING the file is absent —
+                    # cacheable. A transient transport error (timeout,
+                    # reset, 5xx) propagates UN-cached: memoizing {} there
+                    # would silently disable verification for the whole
+                    # process on a store that does publish digests.
+                    if exc.code in (404, 410):
+                        logger.warning("store publishes no %s; shards are "
+                                       "fetched UNVERIFIED", DIGESTS)
+                        self._digests = {}
+                    elif exc.code == 403:
+                        # Forbidden is ambiguous: S3/GCS static hosting
+                        # without list permission answers 403 for absent
+                        # keys, but 403 on a store that DOES publish
+                        # digests.json means an auth misconfiguration —
+                        # memoizing it would silently disable sha256
+                        # verification for the process lifetime. Degrade
+                        # for THIS call only (error-level, un-memoized) so
+                        # every span load re-probes and the operator sees
+                        # a repeating error, and a fixed ACL recovers
+                        # without a restart.
+                        logger.error(
+                            "store answered 403 for %s; treating as absent "
+                            "for this fetch only — shards are UNVERIFIED "
+                            "until the store stops forbidding the digest "
+                            "file (fix the ACL or delete the file to get a "
+                            "clean 404)", DIGESTS)
+                        return {}
+                    else:
                         raise
-                    logger.warning("store publishes no %s; shards are "
-                                   "fetched UNVERIFIED", DIGESTS)
-                    self._digests = {}
             return self._digests
 
     def weight_map(self) -> Dict[str, str]:
@@ -174,6 +199,7 @@ class RemoteShardStore:
                     if not isinstance(wm, dict):
                         raise ValueError("weight_map is not a mapping")
                     self._weight_map = dict(wm)
+                    self._persist_lru()
                     return self._weight_map
                 except (ValueError, KeyError) as exc:
                     # Present-but-malformed index (e.g. a misconfigured
@@ -199,6 +225,7 @@ class RemoteShardStore:
             with safe_open(os.path.join(self.cache_dir, SINGLE),
                            framework="flax") as f:
                 self._weight_map = {k: SINGLE for k in f.keys()}
+            self._persist_lru()
             return self._weight_map
 
     # Tokenizer files a checkpoint MAY publish (best-effort: absence is
@@ -217,6 +244,7 @@ class RemoteShardStore:
                     self._fetch_to_cache(name)
                 except OSError:
                     pass
+            self._persist_lru()
             return self.cache_dir
 
     # -- span logic --------------------------------------------------------
@@ -248,6 +276,7 @@ class RemoteShardStore:
                                          is_last=is_last)
             paths = [self._fetch_to_cache(n) for n in names]
             self._evict(keep=set(names))
+            self._persist_lru()
             return paths
 
     def load_stage(self, cfg: ModelConfig, spec, dtype=None):
@@ -270,7 +299,13 @@ class RemoteShardStore:
     # -- cache management --------------------------------------------------
 
     def _touch(self, name: str) -> None:
+        """In-memory recency bump only — cheap enough for per-shard calls.
+        The disk persist is batched: one ``_persist_lru`` per public
+        fetch operation, not one read-merge-rewrite of the whole state file
+        per touch (which made span loads O(shards × state-size) in file IO)."""
         self._lru[name] = time.time()
+
+    def _persist_lru(self) -> None:
         try:
             # Merge-on-write: other PROCESSES sharing this cache dir write
             # their own stamps to the same file; blind-rewriting from this
@@ -315,6 +350,11 @@ class RemoteShardStore:
         excess = self.cache_bytes() - self.max_cache_bytes
         if excess <= 0:
             return
+        # Publish our in-memory touches AND merge other processes' stamps
+        # from disk before choosing victims: deciding on a stale private
+        # view could evict a shard a co-hosted process touched after our
+        # last merge (its only other shield is the mtime grace period).
+        self._persist_lru()
         now = time.time()
         cands = []
         for f in os.listdir(self.cache_dir):

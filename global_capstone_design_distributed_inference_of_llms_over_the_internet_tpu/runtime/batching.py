@@ -465,18 +465,28 @@ class BatchingStageAdapter:
         # tables so a batched server advertises real admission headroom.
         self.arena = _SlotArenaView(inner, self._lock)
 
-    def warmup(self) -> None:
-        """Pre-compile the engine's two programs (prefill at the smallest
+    def warmup(self, speculative_k: int = 0) -> None:
+        """Pre-compile the engine's programs (prefill at the smallest
         bucket + the batched decode step) so the first real session doesn't
-        pay compile latency — the serve-mode analogue of StageExecutor.warmup."""
+        pay compile latency — the serve-mode analogue of StageExecutor.warmup.
+
+        ``speculative_k > 0`` additionally warms every speculative decode
+        width 2..K+1 — the n-gram drafter returns VARIABLE-length drafts
+        (whatever follow it matched, often < K), so any unwarmed width
+        would compile inside the round leader's lock hold on first use,
+        stalling every concurrent round and the heartbeat's arena view for
+        the compile duration."""
         first = self.spec.is_first
         d = self.cfg.hidden_size
         x = (np.zeros((1, 4), np.int32) if first
              else np.zeros((1, 4, d), np.float32))
         self.inner.prefill("__warmup__", x)
-        step = (np.zeros((1, 1), np.int32) if first
-                else np.zeros((1, 1, d), np.float32))
-        self.inner.decode_batch({"__warmup__": jnp.asarray(step)})
+        widths = [1] + list(range(2, speculative_k + 2))
+        for t in widths:
+            step = (np.zeros((1, t), np.int32) if first
+                    else np.zeros((1, t, d), np.float32))
+            self.inner.rewind("__warmup__", 4)
+            self.inner.decode_batch({"__warmup__": jnp.asarray(step)})
         self.inner.end_session("__warmup__")
 
     # -- protocol ----------------------------------------------------------
@@ -600,22 +610,25 @@ class BatchingStageAdapter:
                     f"session {sid}: concurrent decode for one session")
             r.reqs[sid] = req
         if leader:
-            time.sleep(self.window_s)
-            with self._lock:
-                r.closed = True
-                if self._rounds.get(t) is r:
-                    del self._rounds[t]
-                # Re-validate under the lock: a session may have been
-                # dropped (or otherwise invalidated) since it joined.
-                # Exclusions fail ONLY their own waiter.
-                good = {}
-                for s_id, rq in r.reqs.items():
-                    reason = self._validate(rq)
-                    if reason is None:
-                        good[s_id] = rq
-                    else:
-                        r.bad[s_id] = reason
-                try:
+            # The whole leader path runs under try/finally: an unexpected
+            # exception anywhere (not just inside decode_batch) must still
+            # release the followers, else they block for step_timeout.
+            try:
+                time.sleep(self.window_s)
+                with self._lock:
+                    r.closed = True
+                    if self._rounds.get(t) is r:
+                        del self._rounds[t]
+                    # Re-validate under the lock: a session may have been
+                    # dropped (or otherwise invalidated) since it joined.
+                    # Exclusions fail ONLY their own waiter.
+                    good = {}
+                    for s_id, rq in r.reqs.items():
+                        reason = self._validate(rq)
+                        if reason is None:
+                            good[s_id] = rq
+                        else:
+                            r.bad[s_id] = reason
                     if good:
                         r.outs = self.inner.decode_batch(
                             {s_id: rq.hidden for s_id, rq in good.items()})
@@ -625,9 +638,14 @@ class BatchingStageAdapter:
                             s_id: int(self.inner.lengths[self.inner.slot(s_id)])
                             for s_id in good
                         }
-                except Exception as exc:  # whole-round failure
-                    r.err = exc
-            r.event.set()
+            except Exception as exc:  # whole-round failure
+                r.err = exc
+                with self._lock:  # a dead round must not accept joiners
+                    r.closed = True
+                    if self._rounds.get(t) is r:
+                        del self._rounds[t]
+            finally:
+                r.event.set()
         elif not r.event.wait(self.step_timeout):
             raise StageExecutionError("batched step timed out")
         if r.err is not None:

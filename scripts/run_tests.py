@@ -26,11 +26,19 @@ Usage:
 """
 
 import os
+import re
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# tests/conftest.py prints this at each session's end when any parity test
+# recovered on rerun (count EXCLUDES the intentional canary). The per-shard
+# threshold tolerates one recovery per process; this runner aggregates
+# across shards so two environmental recoveries anywhere in one full-suite
+# run still fail it (the "repeated recoveries are a bug signal" rule).
+_RERUN_RE = re.compile(r"PARITY_RERUN_COUNT=(\d+)")
 
 # Whole-file shards, grouped to keep each process's compile count (and so
 # its mmap total) far below vm.max_map_count. Order mirrors pytest's
@@ -68,6 +76,7 @@ def main() -> int:
 
     t0 = time.time()
     failures = []
+    parity_reruns = 0
     for i, files in enumerate(SHARDS, 1):
         missing = [f for f in files
                    if not os.path.exists(os.path.join(REPO, "tests", f))]
@@ -80,11 +89,21 @@ def main() -> int:
                *(os.path.join("tests", f) for f in files)]
         print(f"[shard {i}/{len(SHARDS)}] {' '.join(files)}", flush=True)
         t = time.time()
-        r = subprocess.run(cmd, cwd=REPO)
-        print(f"[shard {i}] exit={r.returncode} in {time.time() - t:.0f}s",
+        # Tee the shard's stdout so the rerun-count lines are both shown
+        # and aggregated (stderr stays inherited/live).
+        proc = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
+                                text=True)
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            sys.stdout.write(line)
+            m = _RERUN_RE.search(line)
+            if m:
+                parity_reruns += int(m.group(1))
+        rc = proc.wait()
+        print(f"[shard {i}] exit={rc} in {time.time() - t:.0f}s",
               flush=True)
-        if r.returncode != 0:
-            failures.append((i, r.returncode))
+        if rc != 0:
+            failures.append((i, rc))
 
     # Completeness guard: a test file added without updating SHARDS must
     # fail the run, not silently skip.
@@ -97,6 +116,14 @@ def main() -> int:
         failures.append(("coverage", unsharded))
 
     total = time.time() - t0
+    if parity_reruns > 1:
+        print(f"PARITY RERUNS: {parity_reruns} non-canary recoveries "
+              "across shards — exceeds the single-recovery allowance; "
+              "re-triage (tests/conftest.py quarantine note)")
+        failures.append(("parity-reruns", parity_reruns))
+    elif parity_reruns:
+        print("PARITY RERUNS: 1 non-canary recovery (within allowance; "
+              "re-triage if the box was idle)")
     if failures:
         print(f"FULL SUITE: FAILED shards={failures} in {total:.0f}s")
         return 1

@@ -44,6 +44,26 @@ def rng():
 
 
 def pytest_sessionfinish(session, exitstatus):
+    # Machine-readable parity-rerun accounting (advisor r3): a rerun that
+    # "recovers" must not scroll by as a warning only. Every run records the
+    # count + nodeids (stdout line parsed by scripts/run_tests.py, plus the
+    # pytest cache); more than one NON-canary rerun in one process exceeds
+    # the environmental-corruption allowance and fails the run for
+    # re-triage — repeated recoveries are a bug signal, not weather.
+    if _PARITY_RERUNS:
+        noncanary = [n for n in _PARITY_RERUNS if _CANARY not in n]
+        print(f"\n[conftest] PARITY_RERUN_COUNT={len(noncanary)} "
+              f"(+{len(_PARITY_RERUNS) - len(noncanary)} canary) "
+              f"nodes={noncanary}")
+        try:
+            session.config.cache.set("parity/last_reruns", _PARITY_RERUNS)
+        except Exception:
+            pass
+        if len(noncanary) > 1:
+            print("[conftest] FAILING the run: more than one non-canary "
+                  "parity rerun in one process — re-triage (see the "
+                  "quarantine note below)")
+            session.exitstatus = 1
     # Memory-map headroom diagnostic: every compiled XLA executable pins
     # mmaps for the life of the process, and a single-process run of the
     # FULL suite deterministically exhausts vm.max_map_count (65530 here)
@@ -114,6 +134,12 @@ import warnings  # noqa: E402
 
 from _pytest.runner import runtestprotocol  # noqa: E402
 
+# Nodeids of parity tests that failed once then recovered on rerun, in
+# order. The canary (below) recovers by construction every full-suite run;
+# it is excluded from the failure threshold in pytest_sessionfinish.
+_PARITY_RERUNS: list = []
+_CANARY = "test_parity_quarantine_canary_recovers_on_rerun"
+
 
 def pytest_runtest_protocol(item, nextitem):
     if item.get_closest_marker("parity") is None:
@@ -130,6 +156,7 @@ def pytest_runtest_protocol(item, nextitem):
             item._initrequest()
         rerun = runtestprotocol(item, nextitem=nextitem, log=False)
         if not any(r.failed for r in rerun):
+            _PARITY_RERUNS.append(item.nodeid)
             warnings.warn(
                 f"PARITY RERUN: {item.nodeid} failed once then passed "
                 "clean on immediate rerun — load-induced environmental "

@@ -214,11 +214,12 @@ def test_adapter_serves_concurrent_clients_through_transport():
                                  slots=4, max_len=64)
     adapter = BatchingStageAdapter(inner, window_s=0.05, peer_id="batched")
 
-    # Diagnostic trace: this test has flaked rarely under heavy load with a
+    # Diagnostic trace: this test flaked rarely under heavy load with a
     # deterministic-looking 2-step state rewind that no standalone repro
-    # (scripts/repro_adapter_flake.py, 15 loaded trials) reproduces. Record
-    # every request/outcome so the NEXT in-suite failure carries its own
-    # event history instead of just a token diff.
+    # ever reproduced; root-caused round 3 to vm.max_map_count exhaustion
+    # (see scripts/run_tests.py header — the repro script was retired).
+    # Keep the trace so any future in-suite failure carries its own event
+    # history instead of just a token diff.
     import time as _time
 
     trace = []
