@@ -77,6 +77,7 @@ class RemoteShardStore:
         os.makedirs(cache_dir, exist_ok=True)
         self.fetches: List[str] = []     # every remote GET, in order (tests)
         self._digests: Optional[Dict[str, str]] = None
+        self._digests_403_until = 0.0
         self._weight_map: Optional[Dict[str, str]] = None
         # One lock serializes fetch/evict/load within the process: a store
         # is memoized and shared by every serving role (elastic servers
@@ -147,8 +148,15 @@ class RemoteShardStore:
 
     # -- store metadata ----------------------------------------------------
 
+    # How long a 403 on digests.json is treated as "absent" before the next
+    # re-probe (bounds probe/log volume to ~1 per TTL, not 1 per shard).
+    DIGEST_403_TTL_S = 60.0
+
     def digests(self) -> Dict[str, str]:
         with self._op_lock:
+            if (self._digests is None
+                    and time.monotonic() < self._digests_403_until):
+                return {}
             if self._digests is None:
                 import urllib.error
 
@@ -169,18 +177,20 @@ class RemoteShardStore:
                         # without list permission answers 403 for absent
                         # keys, but 403 on a store that DOES publish
                         # digests.json means an auth misconfiguration —
-                        # memoizing it would silently disable sha256
-                        # verification for the process lifetime. Degrade
-                        # for THIS call only (error-level, un-memoized) so
-                        # every span load re-probes and the operator sees
-                        # a repeating error, and a fixed ACL recovers
-                        # without a restart.
+                        # memoizing it forever would silently disable
+                        # sha256 verification for the process lifetime.
+                        # Degrade with a short TTL (error-level) so a span
+                        # load probes once, the operator sees a repeating
+                        # error across operations, and a fixed ACL
+                        # recovers without a restart.
                         logger.error(
                             "store answered 403 for %s; treating as absent "
-                            "for this fetch only — shards are UNVERIFIED "
+                            "for the next %.0fs — shards are UNVERIFIED "
                             "until the store stops forbidding the digest "
                             "file (fix the ACL or delete the file to get a "
-                            "clean 404)", DIGESTS)
+                            "clean 404)", DIGESTS, self.DIGEST_403_TTL_S)
+                        self._digests_403_until = (
+                            time.monotonic() + self.DIGEST_403_TTL_S)
                         return {}
                     else:
                         raise

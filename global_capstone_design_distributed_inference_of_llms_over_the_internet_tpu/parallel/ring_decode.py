@@ -1,0 +1,355 @@
+"""Multi-session ring decode: concurrent sessions fill the pipeline bubble.
+
+The GPipe-style fused pipeline (`parallel.pipeline`) serves ONE session's
+microbatches: during decode, a token must traverse all S stages before the
+next token can start, so S-1 of S chips idle every tick (measured
+bubble_frac 0.33-0.49 in BENCH_r03 `pipeline_microbatch_s4`). The fix —
+and the reference's whole serving model, which its GPU deployment could
+never exploit because each stage was a separate host
+(`petals/server/handler.py:132-195`: every handler serves many concurrent
+sessions; task pools `petals/server/task_pool.py:29-167` exist to batch
+them) — is MULTI-SESSION decode: G >= S independent session groups rotate
+through the stages, stage s advancing group ``(t - s) mod G`` at tick t.
+
+Steady state: every stage busy every tick, one sampled token per tick
+(times the per-group slot batch B). The only bubble is the S-1-tick
+pipeline fill at the start of a chunk:
+
+    bubble_frac = (S - 1) / (G * n_steps + S - 1)      -> ~0 for long runs
+
+Design (one jitted program, ``lax.ppermute`` ring under ``shard_map``):
+
+  * the KV layout IS the fused pipeline's ([S, L/S, G, B, max_len, Hkv, Dh],
+    stage-sharded, group axis == the GPipe microbatch axis), so prefill
+    reuses ``IciPipeline.forward`` with M = G unchanged and ring decode
+    continues on the same buffers;
+  * the ring carry is (hidden [B,1,D], token [B]): intermediate edges use
+    the hidden, the wrap edge S-1 -> 0 uses the token — the last stage's
+    freshly sampled token re-enters the pipeline as the embedding input of
+    that group's next position. With G == S it is consumed the very next
+    tick; with G > S stage 0 parks it in a [G, B] token buffer until the
+    rotation comes back around (write-before-read in the same tick makes
+    G == S a degenerate no-wait case of the same code path);
+  * embedding (stage 0) and final-norm + head + argmax (last stage) run
+    INSIDE the shard-mapped body — sampling is part of the ring, not a host
+    round trip. The head runs under ``lax.cond`` so intermediate stages
+    skip its FLOPs; note this makes the LAST stage the per-tick critical
+    path (span + head) — balance by giving it fewer layers if profiling
+    shows it dominating (the TCP path's balance_quality analogue);
+  * per-group cache lengths [G] are device-local state: each stage
+    increments only the group it just served, so positions/caches stay
+    correct even though stages touch a group at different ticks.
+
+Chunked use mirrors `runtime.fused_decode`: the caller runs N steps per
+call (n is TRACED — one compile serves every chunk size), checks stop
+conditions between chunks, and a finished group's slot can be re-prefilled
+by a masked single-group prefill (see `ring_prefill_group`) without
+touching the other groups' caches — continuous batching across the
+pipeline, not just across slots of one stage.
+
+Greedy sampling (argmax) is fused here; distributed sampled serving stays
+on the per-step final-hop sampler which needs live request metadata
+(`runtime.executor._sample_last`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..models.transformer import _norm, stack_forward
+from .pipeline import IciPipeline, _kv_spec
+
+Params = Dict[str, Any]
+
+
+def _ring_body(cfg: ModelConfig, num_stages: int, num_groups: int,
+               max_steps: int, exact_head: bool,
+               tp_axis: Optional[str] = None):
+    """shard_map body: the tick loop. Local views per stage device:
+    layers [1, L/S, ...]; kv [1, L/S, G, B, max_len, Hkv, Dh];
+    tokens0 [G, B], lens0 [G] (replicated in, device-local thereafter)."""
+    S, G = num_stages, num_groups
+
+    def body(layers, embed_p, head_p, tokens0, k_all, v_all, lens0, n):
+        layers = jax.tree.map(lambda x: x[0], layers)
+        k_all, v_all = k_all[0], v_all[0]     # [L/S, G, B, max_len, Hkv, Dh]
+        s = jax.lax.axis_index("stage")
+        is_last = s == S - 1
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        B = tokens0.shape[1]
+        D = cfg.hidden_size
+        wte = embed_p["wte"]
+
+        def embed_tok(tok, pos):
+            # tok [B] -> [B, 1, D]; mirrors fused_decode._decode_step.
+            x = jnp.take(wte, tok[:, None], axis=0)
+            if cfg.positional == "learned":
+                p = jnp.clip(pos, 0, cfg.max_position_embeddings - 1)
+                x = x + jnp.take(embed_p["wpe"], p, axis=0)
+            return x
+
+        if cfg.tie_word_embeddings:
+            w_head = wte                                   # [V, D]
+        else:
+            w_head = head_p["lm_head"]["w"].T              # [V, D]
+        hdt = jnp.float32 if exact_head else w_head.dtype
+
+        def head_argmax(h):
+            # h [B, 1, D] -> greedy token [B]; transposed weights-stationary
+            # head fused with argmax (fused_decode's measured layout).
+            hn = _norm(cfg, head_p["final_norm"], h)[:, 0]  # [B, D]
+            logits_t = w_head.astype(hdt) @ hn.T.astype(hdt)  # [V, B]
+            return jnp.argmax(logits_t.astype(jnp.float32), axis=0).astype(
+                jnp.int32)
+
+        def tick(t, carry):
+            hid_rx, tok_rx, tok_buf, k_all, v_all, lens, outs = carry
+            # Stage 0 first PARKS the wrap token (sampled at tick t-1 by the
+            # last stage for group (t - S) mod G), THEN reads its current
+            # group's token — write-before-read makes G == S the no-buffer
+            # case of the same code.
+            wg = jnp.mod(t - S, G)
+            parked = jax.lax.dynamic_update_index_in_dim(
+                tok_buf, tok_rx, wg, 0)
+            tok_buf = jnp.where((s == 0) & (t >= S), parked, tok_buf)
+
+            g = jnp.mod(t - s, G)
+            valid = (t >= s) & (t - s < G * n)
+            myl = jax.lax.dynamic_index_in_dim(lens, g, 0, keepdims=False)
+            pos = myl + jnp.zeros((B, 1), jnp.int32)
+            tok_in = jax.lax.dynamic_index_in_dim(
+                tok_buf, jnp.mod(t, G), 0, keepdims=False)       # [B]
+            x_in = jnp.where(s == 0, embed_tok(tok_in, pos), hid_rx)
+
+            kc = jax.lax.dynamic_index_in_dim(k_all, g, 1, keepdims=False)
+            vc = jax.lax.dynamic_index_in_dim(v_all, g, 1, keepdims=False)
+            out, nk, nv = stack_forward(
+                cfg, layers, x_in, pos, kc, vc, myl, tp_axis=tp_axis)
+            # Bubble ticks (fill/drain) compute on garbage; their writes
+            # must not land.
+            nk = jnp.where(valid, nk, kc)
+            nv = jnp.where(valid, nv, vc)
+            k_all = jax.lax.dynamic_update_index_in_dim(k_all, nk, g, 1)
+            v_all = jax.lax.dynamic_update_index_in_dim(v_all, nv, g, 1)
+            lens = jnp.where(
+                valid,
+                jax.lax.dynamic_update_index_in_dim(lens, myl + 1, g, 0),
+                lens)
+
+            # Only the last stage pays the head matmul (lax.cond, runtime
+            # branch per device — intermediate stages skip the FLOPs).
+            tok_out = jax.lax.cond(
+                is_last & valid,
+                lambda: head_argmax(out),
+                lambda: jax.lax.pcast(jnp.zeros((B,), jnp.int32),
+                                      ("stage",), to="varying"))
+            step_i = (t - (S - 1)) // G
+            rec = jax.lax.dynamic_update_slice(
+                outs, tok_out[None, None, :], (step_i, g, 0))
+            outs = jnp.where(is_last & valid, rec, outs)
+
+            hid_rx = jax.lax.ppermute(out, "stage", perm)
+            tok_rx = jax.lax.ppermute(tok_out, "stage", perm)
+            return hid_rx, tok_rx, tok_buf, k_all, v_all, lens, outs
+
+        varying = lambda x: jax.lax.pcast(x, ("stage",), to="varying")
+        hid0 = varying(jnp.zeros((B, 1, D), wte.dtype))
+        tok0 = varying(jnp.zeros((B,), jnp.int32))
+        outs0 = varying(jnp.zeros((max_steps, G, B), jnp.int32))
+        tok_buf0 = varying(tokens0)
+        lens = varying(lens0)
+
+        _, _, _, k_all, v_all, lens, outs = jax.lax.fori_loop(
+            0, G * n + S - 1, tick,
+            (hid0, tok0, tok_buf0, k_all, v_all, lens, outs0))
+        # Only the last stage populated outs; psum replicates it.
+        outs = jax.lax.psum(
+            jnp.where(is_last, outs, jnp.zeros_like(outs)), "stage")
+        return outs, k_all[None], v_all[None]
+
+    return body
+
+
+@dataclasses.dataclass
+class RingDecoder:
+    """Compiled multi-session ring-decode runner over an IciPipeline's mesh,
+    params, and KV buffers. ``pipe.num_micro`` is the session-group count G
+    (must be >= num_stages for gapless rotation)."""
+
+    pipe: IciPipeline
+    max_steps: int
+    _step: Any
+
+    @staticmethod
+    def build(pipe: IciPipeline, max_steps: int = 128,
+              exact_head: bool = True) -> "RingDecoder":
+        S, G = pipe.num_stages, pipe.num_micro
+        if G < S:
+            raise ValueError(
+                f"ring decode needs sessions >= stages for a gapless "
+                f"rotation: num_micro (session groups) {G} < num_stages {S}"
+                " — a sampled token would be needed before the wrap edge "
+                "delivers it")
+        cfg = pipe.cfg
+        tp_axis = "tp" if pipe.tp > 1 else None
+        body = _ring_body(cfg, S, G, max_steps, exact_head, tp_axis=tp_axis)
+        spec_kv = _kv_spec(pipe.tp)
+        layer_specs = jax.tree.map(lambda x: x.sharding.spec,
+                                   pipe.layers_stacked)
+        mesh = pipe.mesh
+
+        @partial(jax.jit, donate_argnums=(4, 5))
+        def step(embed_p, head_p, layers_p, tokens0, k_all, v_all, lens, n):
+            sharded = shard_map(
+                body, mesh=mesh,
+                in_specs=(layer_specs, P(), P(), P(), spec_kv, spec_kv,
+                          P(), P()),
+                out_specs=(P(), spec_kv, spec_kv),
+            )
+            return sharded(layers_p, embed_p, head_p, tokens0, k_all, v_all,
+                           lens, n)
+
+        return RingDecoder(pipe=pipe, max_steps=max_steps, _step=step)
+
+    def decode(
+        self,
+        tokens0: jnp.ndarray,     # [G, B] int32: last token per session row
+        k_all: jnp.ndarray,
+        v_all: jnp.ndarray,
+        lens: jnp.ndarray,        # [G] int32 per-group cache lengths
+        n: int,                   # steps this chunk (traced; <= max_steps)
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Run n ring-decode steps for every session group. Returns
+        (toks [max_steps, G, B] — rows >= n are zero, toks[i, g, b] is the
+        i-th new token of session (g, b) —, new k, new v). New per-group
+        lengths are deterministically ``lens + n``."""
+        G, B = tokens0.shape
+        if n > self.max_steps:
+            raise ValueError(
+                f"n {n} > max_steps {self.max_steps} (the output buffer is "
+                "statically sized; chunk the call)")
+        if G != self.pipe.num_micro:
+            raise ValueError(
+                f"tokens0 has {G} session groups, pipeline compiled for "
+                f"{self.pipe.num_micro}")
+        if B != k_all.shape[3]:
+            raise ValueError(
+                f"tokens0 slot batch {B} != KV cache batch {k_all.shape[3]}")
+        return self._step(self.pipe.embed, self.pipe.head,
+                          self.pipe.layers_stacked, tokens0, k_all, v_all,
+                          lens, jnp.int32(n))
+
+
+def make_ring_prefill_group(pipe: IciPipeline, exact_head: bool = True):
+    """Build a jitted SINGLE-GROUP prefill: write a new session's prompt KV
+    into group slot ``g`` without touching any other group's cache — the
+    continuous-batching join path (a finished session's slot is re-prefilled
+    between decode chunks while the other G-1 groups' caches stay live).
+
+    Returns ``fn(ids [B, T], k_all, v_all, g) -> (tok0 [B], k, v)`` where
+    ``tok0`` is the greedy first token (the caller then sets
+    ``lens[g] = T`` and hands tok0 to the next ``RingDecoder.decode`` call
+    via its tokens0 row).
+    """
+    cfg = pipe.cfg
+    S = pipe.num_stages
+    tp_axis = "tp" if pipe.tp > 1 else None
+    spec_kv = _kv_spec(pipe.tp)
+    layer_specs = jax.tree.map(lambda x: x.sharding.spec,
+                               pipe.layers_stacked)
+    mesh = pipe.mesh
+
+    def body(layers, embed_p, head_p, x, k_all, v_all, g):
+        layers = jax.tree.map(lambda q: q[0], layers)
+        k_all, v_all = k_all[0], v_all[0]
+        s = jax.lax.axis_index("stage")
+        is_last = s == S - 1
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        b, t, _ = x.shape
+
+        kc = jax.lax.dynamic_index_in_dim(k_all, g, 1, keepdims=False)
+        vc = jax.lax.dynamic_index_in_dim(v_all, g, 1, keepdims=False)
+        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None],
+                                     (b, t))
+
+        def tick(ti, carry):
+            received, kc, vc, last_h = carry
+            x_in = jnp.where(s == 0, x, received)
+            out, nk, nv = stack_forward(
+                cfg, layers, x_in, positions, kc, vc, jnp.int32(0),
+                tp_axis=tp_axis)
+            active = ti == s          # sequential: stage s fires at tick s
+            kc = jnp.where(active, nk, kc)
+            vc = jnp.where(active, nv, vc)
+            last_h = jnp.where(active & is_last, out, last_h)
+            received = jax.lax.ppermute(out, "stage", perm)
+            return received, kc, vc, last_h
+
+        varying = lambda q: jax.lax.pcast(q, ("stage",), to="varying")
+        received = varying(jnp.zeros_like(x))
+        last_h = varying(jnp.zeros_like(x))
+        received, kc, vc, last_h = jax.lax.fori_loop(
+            0, S, tick, (received, kc, vc, last_h))
+        k_all = jax.lax.dynamic_update_index_in_dim(k_all, kc, g, 1)
+        v_all = jax.lax.dynamic_update_index_in_dim(v_all, vc, g, 1)
+
+        if cfg.tie_word_embeddings:
+            w_head = embed_p["wte"]
+        else:
+            w_head = head_p["lm_head"]["w"].T
+        hdt = jnp.float32 if exact_head else w_head.dtype
+        hn = _norm(cfg, head_p["final_norm"], last_h)[:, -1]     # [B, D]
+        logits_t = w_head.astype(hdt) @ hn.T.astype(hdt)         # [V, B]
+        tok0 = jnp.argmax(logits_t.astype(jnp.float32), axis=0).astype(
+            jnp.int32)
+        tok0 = jax.lax.psum(
+            jnp.where(is_last, tok0, jnp.zeros_like(tok0)), "stage")
+        return tok0, k_all[None], v_all[None]
+
+    from ..models.transformer import embed_tokens
+
+    @partial(jax.jit, donate_argnums=(4, 5))
+    def fn(embed_p, head_p, layers_p, ids, k_all, v_all, g):
+        b, t = ids.shape
+        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None],
+                                     (b, t))
+        x = embed_tokens(cfg, embed_p, ids, positions)
+        sharded = shard_map(
+            body, mesh=mesh,
+            in_specs=(layer_specs, P(), P(), P(), spec_kv, spec_kv, P()),
+            out_specs=(P(), spec_kv, spec_kv),
+        )
+        return sharded(layers_p, embed_p, head_p, x, k_all, v_all, g)
+
+    def run(ids: jnp.ndarray, k_all, v_all, g) -> Tuple[jnp.ndarray, Any, Any]:
+        return fn(pipe.embed, pipe.head, pipe.layers_stacked,
+                  jnp.asarray(ids, jnp.int32), k_all, v_all, jnp.int32(g))
+
+    return run
+
+
+def ring_generate(pipe: IciPipeline, rd: RingDecoder, ids: jnp.ndarray,
+                  k_all: jnp.ndarray, v_all: jnp.ndarray,
+                  n_tokens: int) -> jnp.ndarray:
+    """Convenience driver: GPipe prefill (M = G microbatches, one per
+    session group) + greedy ring decode. ids [G, B, T] (equal prompt
+    lengths; pad shorter prompts). Returns tokens [n_tokens, G, B]."""
+    G, B, T = ids.shape
+    logits, k_all, v_all = pipe.forward(ids, k_all, v_all, jnp.int32(0))
+    tokens0 = jnp.argmax(
+        logits[:, :, -1].astype(jnp.float32), axis=-1).astype(jnp.int32)
+    if n_tokens == 1:
+        return tokens0[None]
+    lens = jnp.full((G,), T, jnp.int32)
+    # tokens0 (from the prefill logits) IS generated token 1; the ring
+    # produces tokens 2..n_tokens.
+    toks, k_all, v_all = rd.decode(tokens0, k_all, v_all, lens, n_tokens - 1)
+    return jnp.concatenate([tokens0[None], toks[: n_tokens - 1]], axis=0)

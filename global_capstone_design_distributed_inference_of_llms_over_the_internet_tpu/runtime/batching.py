@@ -486,7 +486,12 @@ class BatchingStageAdapter:
             step = (np.zeros((1, t), np.int32) if first
                     else np.zeros((1, t, d), np.float32))
             self.inner.rewind("__warmup__", 4)
-            self.inner.decode_batch({"__warmup__": jnp.asarray(step)})
+            out = self.inner.decode_batch({"__warmup__": jnp.asarray(step)})
+            if self.spec.is_last and t > 1:
+                # The verify path's head projection over [n, K+1, D] is its
+                # own program shape — warm it too, or the first speculative
+                # round compiles it inside the leader's lock.
+                self.inner.logits(out["__warmup__"])
         self.inner.end_session("__warmup__")
 
     # -- protocol ----------------------------------------------------------

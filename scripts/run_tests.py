@@ -58,7 +58,7 @@ SHARDS = [
     # 4: protocol extensions
     ["test_push_chain.py", "test_quant.py", "test_quarantine_hook.py",
      "test_remote_store.py", "test_ring_attention.py",
-     "test_routing_rtt.py"],
+     "test_ring_decode.py", "test_routing_rtt.py"],
     # 5: pipeline runtime + serving engines
     ["test_runtime_pipeline.py", "test_serve_batched.py",
      "test_serve_sp.py", "test_serve_tp.py", "test_sp_stage.py"],

@@ -1,0 +1,185 @@
+"""Multi-session ring decode vs per-session oracle on the virtual CPU mesh.
+
+The rotation schedule (stage s advances session group (t - s) mod G at tick
+t, sampled tokens riding the wrap edge back to stage 0) must be
+token-identical to decoding every session independently on one device —
+the whole point is filling the decode bubble WITHOUT changing results.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+    full_forward,
+    init_kv_cache,
+    init_params,
+    llama_config,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.parallel.pipeline import (
+    IciPipeline,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.parallel.ring_decode import (
+    RingDecoder,
+    ring_generate,
+)
+
+
+def tiny_cfg():
+    return llama_config(vocab_size=257, hidden_size=64, num_layers=8,
+                        num_heads=4, num_kv_heads=2, intermediate_size=128,
+                        max_position_embeddings=64)
+
+
+def oracle_greedy(cfg, params, prompt, n_tokens, max_len=48):
+    """Single-session unpartitioned greedy loop (fp32 argmax)."""
+    kc, vc = init_kv_cache(cfg, cfg.num_layers, 1, max_len)
+    ids = jnp.asarray(np.asarray(prompt, np.int32)[None])
+    logits, kc, vc = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
+    toks = []
+    cur = len(prompt)
+    tok = int(jnp.argmax(logits[0, -1].astype(jnp.float32)))
+    toks.append(tok)
+    for _ in range(n_tokens - 1):
+        logits, kc, vc = full_forward(
+            cfg, params, jnp.asarray([[tok]], jnp.int32), kc, vc,
+            jnp.int32(cur))
+        cur += 1
+        tok = int(jnp.argmax(logits[0, -1].astype(jnp.float32)))
+        toks.append(tok)
+    return toks
+
+
+def _prompts(rng, g, b, t, vocab):
+    return rng.integers(0, vocab, (g, b, t)).astype(np.int32)
+
+
+@pytest.mark.parametrize("num_stages,num_groups,slot_b", [
+    (4, 4, 1),    # G == S: token consumed the tick it arrives (no buffer)
+    (4, 6, 1),    # G > S: wrap tokens park in the buffer for G-S ticks
+    (2, 2, 2),    # slot-batched session groups
+])
+def test_ring_decode_matches_per_session_oracle(num_stages, num_groups,
+                                                slot_b):
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    pipe = IciPipeline.build(cfg, params, num_stages, num_micro=num_groups)
+    rd = RingDecoder.build(pipe, max_steps=16)
+
+    rng = np.random.default_rng(3)
+    t, n_tokens = 5, 8
+    ids = _prompts(rng, num_groups, slot_b, t, cfg.vocab_size)
+    k, v = pipe.init_kv(slot_b, max_len=48)
+    toks = np.asarray(
+        ring_generate(pipe, rd, jnp.asarray(ids), k, v, n_tokens))
+
+    for g in range(num_groups):
+        for b in range(slot_b):
+            ref = oracle_greedy(cfg, params, ids[g, b], n_tokens)
+            assert toks[:, g, b].tolist() == ref, (
+                f"session (g={g}, b={b}) diverged: ring "
+                f"{toks[:, g, b].tolist()} vs oracle {ref}")
+
+
+def test_ring_decode_chunked_matches_single_call():
+    """Two 3-step chunks must equal one 6-step call — lens/token carry is
+    exact across chunk boundaries (the stop-condition check point)."""
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    S, G, B, t = 4, 4, 1, 4
+    pipe = IciPipeline.build(cfg, params, S, num_micro=G)
+    rd = RingDecoder.build(pipe, max_steps=8)
+    rng = np.random.default_rng(7)
+    ids = jnp.asarray(_prompts(rng, G, B, t, cfg.vocab_size))
+
+    k, v = pipe.init_kv(B, max_len=48)
+    logits, k, v = pipe.forward(ids, k, v, jnp.int32(0))
+    tok0 = jnp.argmax(
+        logits[:, :, -1].astype(jnp.float32), -1).astype(jnp.int32)
+    lens = jnp.full((G,), t, jnp.int32)
+
+    k1, v1 = jax.tree.map(jnp.copy, (k, v))
+    one, _, _ = rd.decode(tok0, k1, v1, lens, 6)
+
+    k2, v2 = jax.tree.map(jnp.copy, (k, v))
+    a, k2, v2 = rd.decode(tok0, k2, v2, lens, 3)
+    b_, _, _ = rd.decode(a[2], k2, v2, lens + 3, 3)
+
+    got = np.concatenate([np.asarray(a[:3]), np.asarray(b_[:3])])
+    np.testing.assert_array_equal(got, np.asarray(one[:6]))
+
+
+def test_ring_decode_with_tensor_parallel_stages():
+    """pp x tp composition: 2 stages x 2-way TP on 4 devices, 2 session
+    groups — the ring carry and the per-stage psums must coexist."""
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    pipe = IciPipeline.build(cfg, params, num_stages=2, num_micro=2, tp=2)
+    rd = RingDecoder.build(pipe, max_steps=8)
+    rng = np.random.default_rng(11)
+    ids = _prompts(rng, 2, 1, 4, cfg.vocab_size)
+    k, v = pipe.init_kv(1, max_len=32)
+    toks = np.asarray(
+        ring_generate(pipe, rd, jnp.asarray(ids), k, v, 6))
+    for g in range(2):
+        ref = oracle_greedy(cfg, params, ids[g, 0], 6, max_len=32)
+        assert toks[:, g, 0].tolist() == ref
+
+
+def test_ring_continuous_batching_replaces_one_group():
+    """A finished session's group slot is re-prefilled between chunks while
+    the OTHER groups' caches stay live: the joined session must match a
+    fresh oracle on its new prompt, and the survivors must keep producing
+    exactly their original oracle continuations."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.parallel.ring_decode import (
+        make_ring_prefill_group,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(4), cfg)
+    S, G, B, t = 2, 3, 1, 4
+    pipe = IciPipeline.build(cfg, params, S, num_micro=G)
+    rd = RingDecoder.build(pipe, max_steps=8)
+    prefill_one = make_ring_prefill_group(pipe)
+
+    rng = np.random.default_rng(13)
+    ids = _prompts(rng, G, B, t, cfg.vocab_size)
+    k, v = pipe.init_kv(B, max_len=48)
+    logits, k, v = pipe.forward(jnp.asarray(ids), k, v, jnp.int32(0))
+    tok0 = jnp.argmax(
+        logits[:, :, -1].astype(jnp.float32), -1).astype(jnp.int32)
+    lens = jnp.full((G,), t, jnp.int32)
+
+    # chunk 1: 3 steps for everyone
+    a, k, v = rd.decode(tok0, k, v, lens, 3)
+    lens = lens + 3
+
+    # "session in group 1 finished": re-prefill its slot with a NEW prompt
+    new_prompt = rng.integers(0, cfg.vocab_size, (B, 5)).astype(np.int32)
+    ntok0, k, v = prefill_one(jnp.asarray(new_prompt), k, v, 1)
+    lens = lens.at[1].set(5)
+    tok1 = a[2].at[1].set(ntok0)   # group 1 restarts from its new token
+
+    # chunk 2: 4 more steps
+    b_, k, v = rd.decode(tok1, k, v, lens, 4)
+
+    # survivors (groups 0, 2): tokens across both chunks == their oracle
+    for g in (0, 2):
+        ref = oracle_greedy(cfg, params, ids[g, 0], 8)
+        got = ([int(tok0[g, 0])] + np.asarray(a[:3, g, 0]).tolist()
+               + np.asarray(b_[:4, g, 0]).tolist())
+        assert got[:8] == ref, f"survivor group {g} diverged"
+
+    # joined session: new-prompt oracle
+    refj = oracle_greedy(cfg, params, new_prompt[0], 5)
+    gotj = [int(ntok0[0])] + np.asarray(b_[:4, 1, 0]).tolist()
+    assert gotj == refj, "re-prefilled group diverged from fresh oracle"
+
+
+def test_ring_decode_rejects_fewer_groups_than_stages():
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    pipe = IciPipeline.build(cfg, params, num_stages=4, num_micro=2)
+    with pytest.raises(ValueError, match="sessions >= stages"):
+        RingDecoder.build(pipe)
