@@ -63,6 +63,13 @@ HBM_SPEC_GBPS = (
     ("v4", 1228), ("v3", 900), ("v2", 700),
 )
 
+# Spec bf16 matmul peak by device generation (TFLOP/s). The MFU denominator.
+PEAK_BF16_TFLOPS = (
+    ("v5 lite", 197), ("v5e", 197), ("v5p", 459),
+    ("v6 lite", 918), ("v6e", 918),
+    ("v4", 275), ("v3", 123), ("v2", 46),
+)
+
 
 def spec_bw_gbps() -> float:
     kind = jax.devices()[0].device_kind.lower()
@@ -70,6 +77,31 @@ def spec_bw_gbps() -> float:
         if key in kind:
             return float(bw)
     return 819.0  # unknown: assume the v5e this repo targets
+
+
+def spec_peak_tflops() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for key, tf in PEAK_BF16_TFLOPS:
+        if key in kind:
+            return float(tf)
+    return 197.0
+
+
+def prefill_flops(cfg, params, batch: int, seq: int) -> int:
+    """USEFUL model FLOPs of one prefill: per-layer matmul weights (ndim>=3
+    leaves of the stacked layer tree) x 2 x tokens, causal-halved attention
+    score+value FLOPs, and the last-position head projection (serving needs
+    only the last token's logits; computing more is the program's business
+    and counts against it in the MFU). Dense-MoE note: the dense all-expert
+    formulation really executes every expert, so the full expert count here
+    matches executed work too."""
+    lm = sum(int(np.prod(x.shape))
+             for x in jax.tree.leaves(params["layers"]) if x.ndim >= 3)
+    body = 2 * lm * batch * seq
+    attn = (2 * cfg.num_layers * batch * seq * seq
+            * cfg.num_heads * cfg.head_dim)     # 4*B*H*T^2*Dh, causal /2
+    head = 2 * batch * cfg.hidden_size * cfg.vocab_size
+    return body + attn + head
 
 
 def flagship_cfg():
@@ -143,43 +175,65 @@ def bench_config(name, cfg, params, *, batch, max_len, s1, s2, prefill=64,
     }
 
 
-def bench_prefill(cfg, params, *, batch, seq, n_iter=8, reps=3):
-    """Prefill (TTFT) throughput: N independent prefills of DISTINCT prompts
-    run inside ONE jitted scan, so the ~100 ms per-dispatch tunnel overhead
-    amortizes over N instead of swamping a single call. Reports prompt
-    tokens/s and the per-prefill latency (the TTFT compute floor)."""
+def bench_prefill(cfg, params, *, batch, seq, n1=8, n2=56, reps=4):
+    """Prefill (TTFT) throughput + MFU, SLOPE-timed.
+
+    Round-3 methodology bug (VERDICT r3 item 2, root-caused round 4): the
+    old row ran N=8 prefills in one scan and divided wall by 8 — but one
+    call through the tunnel carries a ~120-190 ms FIXED overhead, so the
+    row published ~23 ms/prefill for work whose true marginal cost is
+    ~5 ms (the "25% MFU" was 4/5ths dispatch). Fix = the same cure
+    bench_config already uses for decode: ONE compiled program (iteration
+    count TRACED via fori_loop over an n2-size buffer of DISTINCT prompts)
+    run at two counts, per-rep PAIRED slopes, median reported. The fixed
+    intercept is reported as dispatch_ms.
+
+    mfu = useful model FLOPs (prefill_flops) / slope / spec bf16 peak."""
     max_len = seq  # prefill-only cache
 
     @jax.jit
-    def many(params, ids_stack):
-        def body(acc, ids):
+    def many(params, xs, n):
+        def body(i, acc):
+            ids = jax.lax.dynamic_index_in_dim(xs, i, 0, keepdims=False)
             kc, vc = init_kv_cache(cfg, cfg.num_layers, batch, max_len,
                                    dtype=jnp.bfloat16)
             logits, _, _ = full_forward(cfg, params, ids, kc, vc,
                                         jnp.int32(0))
             tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-            return acc + tok, tok
-        acc, toks = jax.lax.scan(
-            body, jnp.zeros((batch,), jnp.int32), ids_stack)
-        return acc, toks
+            return acc + tok      # chains every prefill into the fetch
+        return jax.lax.fori_loop(0, n, body,
+                                 jnp.zeros((batch,), jnp.int32))
 
-    best = float("inf")
+    slopes, t1_best = [], float("inf")
     for r in range(reps + 1):
-        ids = jax.random.randint(jax.random.PRNGKey(300 + r),
-                                 (n_iter, batch, seq), 0, cfg.vocab_size,
-                                 jnp.int32)
+        xs = jax.random.randint(jax.random.PRNGKey(300 + r),
+                                (n2, batch, seq), 0, cfg.vocab_size,
+                                jnp.int32)
         t0 = time.perf_counter()
-        acc, toks = many(params, ids)
-        np.asarray(acc)    # depends on every prefill
+        np.asarray(many(params, xs, jnp.int32(n1)))
+        d1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        np.asarray(many(params, xs, jnp.int32(n2)))
+        d2 = time.perf_counter() - t0
         if r > 0:          # r == 0 pays the compile
-            best = min(best, time.perf_counter() - t0)
-    per = best / n_iter
+            slopes.append((d2 - d1) / (n2 - n1))
+            t1_best = min(t1_best, d1)
+    slopes.sort()
+    per = slopes[len(slopes) // 2]
+    fl = prefill_flops(cfg, params, batch, seq)
     return {
         "prompt_tokens_per_s": round(batch * seq / per, 1),
         "prefill_ms": round(per * 1e3, 2),
+        "prefill_ms_spread": [round(slopes[0] * 1e3, 2),
+                              round(slopes[-1] * 1e3, 2)],
+        "dispatch_ms": round(max(0.0, t1_best - n1 * per) * 1e3, 1),
+        "mfu": round(fl / per / (spec_peak_tflops() * 1e12), 3),
+        "model_gflops": round(fl / 1e9, 1),
         "batch": batch, "seq": seq,
-        "note": "per-prefill latency = TTFT compute floor (excludes "
-                "network hops); dispatch amortized over the fused scan",
+        "note": "slope-timed per-prefill latency = TTFT compute floor "
+                "(fixed per-call dispatch excluded and reported; the r3 "
+                "row divided it across 8 iterations instead — see "
+                "docs/PERFORMANCE.md)",
     }
 
 
@@ -535,7 +589,7 @@ def main():
                          s1=8, s2=48, prefill=8, reps=2)
         rs = bench_serving_batched(cfg, params, slots=2, max_len=64,
                                    prefill=8, rounds=8, reps=1)
-        rp = bench_prefill(cfg, params, batch=2, seq=32, n_iter=3, reps=1)
+        rp = bench_prefill(cfg, params, batch=2, seq=32, n1=2, n2=8, reps=1)
         print(json.dumps({"metric": "smoke", "value": r["tokens_per_s"],
                           "unit": "tokens/s", "vs_baseline": 1.0,
                           "configs": {"smoke": r, "smoke_serving": rs,
@@ -569,7 +623,7 @@ def main():
     results["flagship_1b_b16"] = bench_config(
         "flagship_1b_b16", fcfg, fparams, batch=16, max_len=512, s1=S1, s2=S2)
     results["flagship_prefill_b1_s512"] = bench_prefill(
-        fcfg, fparams, batch=1, seq=512, n_iter=4, reps=2)
+        fcfg, fparams, batch=1, seq=512)
     del fparams
 
     # BASELINE config #5: microbatched deep-pipeline decode (subprocess on
