@@ -13,9 +13,19 @@ compute/communication overlap on ICI.
 Causality: query chunk q on device i covers absolute positions
 [i·C, i·C + C); after s ring steps a device holds the KV chunk of device
 (i - s) mod P. Blocks wholly in the future are masked out; the diagonal
-block applies the usual triangular mask. The rotation is always full-ring
-(simple, schedule-static); skipping fully-masked blocks is a later
-optimization.
+block applies the usual triangular mask.
+
+Causal skip (VERDICT r3 item 4): the KV rotation is always full-ring (the
+ppermute is a collective — every device must participate every step), but
+a device whose incoming block is WHOLLY in its future skips the
+score/value compute for it via ``lax.cond`` (a runtime branch, per
+device). Summed over the ring, causal prefill does P(P+1)/2 block
+computes instead of P² — the step-work ratio (P+1)/2P → ~0.5 at large P.
+This cuts total FLOPs/energy; single-ring LATENCY is still P-1 rotations
+because the last device computes at every step (balancing that needs a
+zigzag chunk layout — two half-chunks per device, one low one high —
+which would change sp_stage's on-device sequence layout; measured and
+deferred, see docs/PERFORMANCE.md).
 
 Numerics: scores and the softmax accumulator run in float32 regardless of the
 activation dtype (matching ops.attention's fp32-softmax contract); the output
@@ -83,6 +93,7 @@ def ring_attention(
     q_offset: Optional[jnp.ndarray] = None,
     chunk_positions: Optional[jnp.ndarray] = None,
     causal: bool = True,
+    skip_masked_blocks: bool = True,
 ) -> jnp.ndarray:
     """Exact attention with sequence sharded over `axis_name`.
 
@@ -138,7 +149,21 @@ def ring_attention(
         k_blk, v_blk, m, l, o = carry
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        m, l, o = accumulate(s, k_blk, v_blk, m, l, o)
+        if causal and skip_masked_blocks:
+            # Causal skip: if the incoming block is WHOLLY in this device's
+            # future (its first key position is past our last query), every
+            # score would be masked — skip the block's compute entirely.
+            # The rotation above still ran (collective); only the local
+            # einsum/softmax work is branched out.
+            src = (idx - s) % p
+            wholly_future = src * c > q_offset + (c - 1)
+            m, l, o = jax.lax.cond(
+                wholly_future,
+                lambda m, l, o: (m, l, o),
+                lambda m, l, o: accumulate(s, k_blk, v_blk, m, l, o),
+                m, l, o)
+        else:
+            m, l, o = accumulate(s, k_blk, v_blk, m, l, o)
         return k_blk, v_blk, m, l, o
 
     def vary(x):
@@ -155,11 +180,14 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
-def make_ring_attention_fn(mesh, axis_name: str = "sp"):
+def make_ring_attention_fn(mesh, axis_name: str = "sp",
+                           skip_masked_blocks: bool = True):
     """shard_map-wrapped ring attention over full arrays.
 
     q: [B, T, H, Dh]; k/v: [B, T, Hkv, Dh]; T must divide by the axis size.
     Returns the full [B, T, H, Dh] output (sequence re-assembled).
+    ``skip_masked_blocks=False`` forces the full-ring compute (the bench's
+    comparison baseline for the causal-skip work ratio).
     """
     from jax.sharding import PartitionSpec as P
 
@@ -171,6 +199,7 @@ def make_ring_attention_fn(mesh, axis_name: str = "sp"):
         in_specs=(spec, spec, spec), out_specs=spec,
     )
     def fn(q, k, v):
-        return ring_attention(q, k, v, axis_name)
+        return ring_attention(q, k, v, axis_name,
+                              skip_masked_blocks=skip_masked_blocks)
 
     return fn

@@ -52,6 +52,28 @@ from .ring_attention import (
 Params = Dict[str, Any]
 
 
+class SpSession:
+    """One session's mesh-wide cache state: prefix KV sharded on the
+    sequence axis, generation tail replicated. Multiple sessions coexist on
+    one runner (multi-session sp serving, VERDICT r3 item 5) — each holds
+    its own buffers; the runner's compiled programs are shared, jit
+    re-specializing per padded prompt length."""
+
+    __slots__ = ("pk", "pv", "tk", "tv", "prefix_pad", "prefix_len",
+                 "tail_len")
+
+    def __init__(self):
+        self.pk = self.pv = None   # [L, B, prefix_pad, Hkv, Dh] sharded on T
+        self.tk = self.tv = None   # [L, B, tail_max, Hkv, Dh] replicated
+        self.prefix_pad = 0
+        self.prefix_len = 0
+        self.tail_len = 0
+
+    @property
+    def cache_len(self) -> int:
+        return self.prefix_len + self.tail_len
+
+
 class SpStageRunner:
     """One stage's span executed sequence-parallel over `mesh[axis_name]`.
 
@@ -84,11 +106,9 @@ class SpStageRunner:
         repl = NamedSharding(mesh, P())
         self.params = jax.device_put(params, repl)
 
-        self.prefix_pad = 0     # padded prefill length (sharded axis size)
-        self.prefix_len = 0     # REAL prompt tokens in the prefix cache
-        self.tail_len = 0       # decode tokens in the tail cache
-        self.pk = self.pv = None  # [L, B, prefix_pad, Hkv, Dh] sharded on T
-        self.tk = self.tv = None  # [L, B, tail_max, Hkv, Dh] replicated
+        # Legacy single-session facade state (prefill/decode/reset); the
+        # session-explicit API (start_session/decode_step) carries its own.
+        self._default = SpSession()
         self._prefill_fn = None
         self._decode_fn = None
 
@@ -96,7 +116,47 @@ class SpStageRunner:
 
     @property
     def cache_len(self) -> int:
-        return self.prefix_len + self.tail_len
+        return self._default.cache_len
+
+    @property
+    def prefix_len(self) -> int:
+        return self._default.prefix_len
+
+    @property
+    def tail_len(self) -> int:
+        return self._default.tail_len
+
+    @property
+    def prefix_pad(self) -> int:
+        return self._default.prefix_pad
+
+    @property
+    def pk(self):
+        return self._default.pk
+
+    @property
+    def pv(self):
+        return self._default.pv
+
+    # -- per-device session cost (the admission currency) ---------------
+
+    def prefix_bytes_per_device(self, t: int, batch: int = 1) -> int:
+        """Per-device bytes of a session's sharded prefix KV for a t-token
+        prompt (k + v, padded to the mesh)."""
+        t_pad = -(-t // self.p) * self.p
+        l = max(self.spec.num_layers, 1)
+        return (2 * l * batch * (t_pad // self.p) * self.cfg.num_kv_heads
+                * self.cfg.head_dim * self.dtype.itemsize)
+
+    def tail_bytes_per_device(self, batch: int = 1) -> int:
+        """Per-device bytes of a session's REPLICATED tail KV (k + v)."""
+        l = max(self.spec.num_layers, 1)
+        return (2 * l * batch * self.tail_max * self.cfg.num_kv_heads
+                * self.cfg.head_dim * self.dtype.itemsize)
+
+    def session_bytes_per_device(self, t: int, batch: int = 1) -> int:
+        return (self.prefix_bytes_per_device(t, batch)
+                + self.tail_bytes_per_device(batch))
 
     def _shard_seq(self):
         return NamedSharding(self.mesh, P(None, None, self.axis))
@@ -157,10 +217,10 @@ class SpStageRunner:
 
         return fn
 
-    def prefill(self, x) -> jnp.ndarray:
-        """Run the span over the (long) prompt. x: int ids [B, T] for the
-        first stage, else hidden [B, T, D]. Returns hidden [B, T, D] (global,
-        sequence-sharded; padded rows trimmed). Restarts the session."""
+    def start_session(self, x) -> Tuple[SpSession, jnp.ndarray]:
+        """Prefill a NEW session. x: int ids [B, T] for the first stage,
+        else hidden [B, T, D]. Returns (session, hidden [B, T, D]) — the
+        hidden is global, sequence-sharded, padded rows trimmed."""
         x = jnp.asarray(x)
         b, t = x.shape[0], x.shape[1]
         t_pad = -(-t // self.p) * self.p
@@ -173,16 +233,22 @@ class SpStageRunner:
                              else P(None, self.axis, None)))
         if self._prefill_fn is None:
             self._prefill_fn = self._build_prefill()
-        h, self.pk, self.pv = self._prefill_fn(self.params, x)
-        self.prefix_pad = t_pad
-        self.prefix_len = t
-        self.tail_len = 0
+        sess = SpSession()
+        h, sess.pk, sess.pv = self._prefill_fn(self.params, x)
+        sess.prefix_pad = t_pad
+        sess.prefix_len = t
+        sess.tail_len = 0
         l = max(self.spec.num_layers, 1)
         shape = (l, b, self.tail_max, self.cfg.num_kv_heads, self.cfg.head_dim)
         repl = NamedSharding(self.mesh, P())
-        self.tk = jax.device_put(jnp.zeros(shape, self.dtype), repl)
-        self.tv = jax.device_put(jnp.zeros(shape, self.dtype), repl)
-        return h[:, :t]
+        sess.tk = jax.device_put(jnp.zeros(shape, self.dtype), repl)
+        sess.tv = jax.device_put(jnp.zeros(shape, self.dtype), repl)
+        return sess, h[:, :t]
+
+    def prefill(self, x) -> jnp.ndarray:
+        """Legacy single-session facade: restarts THE session."""
+        self._default, h = self.start_session(x)
+        return h
 
     # ------------------------------------------------------------------
     # Decode: replicated token, sharded-prefix + replicated-tail attention
@@ -270,31 +336,35 @@ class SpStageRunner:
 
         return fn
 
-    def decode(self, x) -> jnp.ndarray:
-        """One decode step. x: int ids [B, 1] for the first stage, else
-        hidden [B, 1, D]. Returns hidden [B, 1, D]; appends to the tail."""
-        if self.pk is None:
+    def decode_step(self, sess: SpSession, x) -> jnp.ndarray:
+        """One decode step for `sess`. x: int ids [B, 1] for the first
+        stage, else hidden [B, 1, D]. Returns hidden [B, 1, D]; appends to
+        the session's tail."""
+        if sess.pk is None:
             raise RuntimeError("decode before prefill")
-        if self.tail_len >= self.tail_max:
+        if sess.tail_len >= self.tail_max:
             raise RuntimeError(
                 f"tail cache full ({self.tail_max}); re-prefill to fold the "
                 "tail into the sharded prefix")
         if self._decode_fn is None:
             self._decode_fn = self._build_decode()
         x = jnp.asarray(x)
-        h, self.tk, self.tv = self._decode_fn(
-            self.params, x, self.pk, self.pv, self.tk, self.tv,
-            jnp.int32(self.prefix_len), jnp.int32(self.tail_len),
-            jnp.int32(self.cache_len))
-        self.tail_len += 1
+        h, sess.tk, sess.tv = self._decode_fn(
+            self.params, x, sess.pk, sess.pv, sess.tk, sess.tv,
+            jnp.int32(sess.prefix_len), jnp.int32(sess.tail_len),
+            jnp.int32(sess.cache_len))
+        sess.tail_len += 1
         return h
 
+    def decode(self, x) -> jnp.ndarray:
+        """Legacy single-session facade over `decode_step`."""
+        return self.decode_step(self._default, x)
+
     def reset(self) -> None:
-        """Drop the session's caches (serving end_session): the sharded
-        prefix and replicated tail buffers are freed; compiled fns stay."""
-        self.pk = self.pv = None
-        self.tk = self.tv = None
-        self.prefix_pad = self.prefix_len = self.tail_len = 0
+        """Drop THE legacy session's caches (serving end_session): the
+        sharded prefix and replicated tail buffers are freed; compiled fns
+        stay."""
+        self._default = SpSession()
 
     # ------------------------------------------------------------------
 
