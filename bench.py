@@ -772,8 +772,12 @@ def main():
                         cand = json.loads(line)
                     except ValueError:
                         continue
-                    if isinstance(cand, dict):   # a scalar/list line is
-                        parsed = cand            # not a result record
+                    # Only a real result record counts — a stray JSON dict
+                    # without unit/metric must fall through to the regex,
+                    # not shadow it.
+                    if (isinstance(cand, dict) and cand.get("unit")
+                            and cand.get("metric")):
+                        parsed = cand
                         break
                 if parsed is None:
                     m = re.search(

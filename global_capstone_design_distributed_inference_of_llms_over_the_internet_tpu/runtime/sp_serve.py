@@ -208,6 +208,13 @@ class SpStageAdapter:
         # freed only AFTER admission succeeds — a queue-timeout refusal must
         # leave the caller's live session intact, not destroy it.
         need = self.runner.session_bytes_per_device(req.seq_len)
+        if need > self.kv_budget_bytes:
+            # Unsatisfiable even on an empty server: refuse NOW — queueing
+            # would stall the client queue_wait_s for a wait nothing can
+            # ever satisfy.
+            raise StageExecutionError(
+                f"session {req.session_id}: prompt needs {need} bytes/"
+                f"device, over the whole KV budget {self.kv_budget_bytes}")
         import time as _time
 
         waited_until = _time.monotonic() + self.queue_wait_s

@@ -54,7 +54,7 @@ SHARDS = [
      "test_load_balancing.py"],
     # 3: oracles + registry + wire
     ["test_models_oracle.py", "test_multi_model.py", "test_net.py",
-     "test_offload.py", "test_partition.py"],
+     "test_offload.py", "test_partition.py", "test_registry_ha.py"],
     # 4: protocol extensions
     ["test_push_chain.py", "test_quant.py", "test_quarantine_hook.py",
      "test_remote_store.py", "test_ring_attention.py",
