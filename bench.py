@@ -845,6 +845,23 @@ def main():
         s2=S2, sustained_gbps=sustained)
     results["flagship_prefill_b1_s512"] = bench_prefill(
         fcfg, fparams, batch=1, seq=512)
+    # int8 weight-only decode (models/quant.py): the b16 decode step is
+    # weight-stream-bound (docs/PERFORMANCE.md breakdown), so halving the
+    # weight bytes is THE lever the roofline analysis names. Same fused
+    # program — QuantizedTensor leaves dequantize per layer inside the
+    # scan; param_bytes counts the int8+scale bytes automatically.
+    try:
+        from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.quant import (
+            quantize_params,
+        )
+
+        qparams = quantize_params(fparams, "int8")
+        results["flagship_1b_b16_int8"] = bench_config(
+            "flagship_1b_b16_int8", fcfg, qparams, batch=16, max_len=512,
+            s1=S1, s2=S2, sustained_gbps=sustained)
+        del qparams
+    except Exception as exc:   # the quant row must not kill the bench
+        results["flagship_1b_b16_int8"] = {"error": str(exc)[:200]}
     del fparams
 
     # BASELINE config #5: microbatched deep-pipeline decode (subprocess on

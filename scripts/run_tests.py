@@ -44,9 +44,14 @@ _RERUN_RE = re.compile(r"PARITY_RERUN_COUNT=(\d+)")
 # its mmap total) far below vm.max_map_count. Order mirrors pytest's
 # alphabetical default so failures are easy to correlate.
 SHARDS = [
-    # 1: models + engines (compile-heavy parity files)
-    ["test_batch_sampling.py", "test_batching.py", "test_beam_search.py",
-     "test_checkpoint_streaming.py", "test_chunked_prefill.py",
+    # 1a/1b: models + engines (the compile-DENSEST files). Round 4: the
+    # concurrent-adapter corruption fired here once at only ~19k/65k maps
+    # on a nominally idle box (then passed 4/4 standalone and the whole
+    # shard passed clean in isolation) — so map-count exhaustion is NOT
+    # the whole story; corruption tracks per-process compile density too.
+    # Splitting the densest shard halves that density.
+    ["test_batch_sampling.py", "test_batching.py", "test_beam_search.py"],
+    ["test_checkpoint_streaming.py", "test_chunked_prefill.py",
      "test_chunked_wire.py", "test_cli.py"],
     # 2: distributed bring-up + elastic serving
     ["test_dcn.py", "test_elastic_server.py", "test_finetune.py",
