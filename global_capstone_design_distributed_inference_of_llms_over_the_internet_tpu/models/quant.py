@@ -180,7 +180,8 @@ def _quantize_leaf(w: jnp.ndarray) -> QuantizedTensor:
 
 # The matmul weight names of models/transformer.py's layer schema. Norms,
 # biases, and the MoE "router" are deliberately absent (full precision).
-_MATMUL_KEYS = frozenset({"wq", "wk", "wv", "wo", "wg", "wu", "wd", "wi"})
+_MATMUL_KEYS = frozenset(
+    {"wq", "wk", "wv", "wqkv", "wo", "wg", "wu", "wd", "wi"})
 
 
 def quantize_layers(layers: Params, quant: str = "int8") -> Params:

@@ -42,6 +42,7 @@ from ..models.config import ModelConfig
 from ..models.partition import StageSpec
 from ..models.transformer import _mlp, _norm, embed_tokens, make_rope, qkv_proj
 from ..ops.rotary import apply_rope
+from ..utils.platform import engine_donation
 from .ring_attention import (
     NEG_INF,
     online_combine,
@@ -102,6 +103,14 @@ class SpStageRunner:
         self.p = int(mesh.shape[axis_name])
         self.tail_max = tail_max
         self.dtype = jnp.dtype(dtype)
+        # Engine-side fused-QKV layout (one projection matmul per layer,
+        # bitwise-identical — models/transformer.fuse_qkv_layers); the sp
+        # axis shards the SEQUENCE, never the projections, so fusion is
+        # always safe here.
+        if isinstance(params, dict) and "layers" in params:
+            from ..models.transformer import fuse_qkv_layers
+
+            params = dict(params, layers=fuse_qkv_layers(params["layers"]))
         # Replicate the span's params over the mesh once.
         repl = NamedSharding(mesh, P())
         self.params = jax.device_put(params, repl)
@@ -268,7 +277,7 @@ class SpStageRunner:
         # Donate the tail caches (updated every step) so the append is
         # in-place; the prefix caches are NOT donated — the same buffers are
         # re-passed for the whole session.
-        @partial(jax.jit, donate_argnums=(4, 5))
+        @partial(jax.jit, donate_argnums=engine_donation(4, 5))
         @partial(jax.shard_map, mesh=mesh, in_specs=in_spec,
                  out_specs=out_spec)
         def fn(params, x, pk, pv, tk, tv, prefix_len, tail_len, pos):

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.config import ModelConfig
+from ..utils.platform import engine_donation
 from ..models.partition import StageSpec
 from ..models.transformer import _mlp, _norm, embed_tokens, make_rope, qkv_proj
 from ..ops.rotary import apply_rope
@@ -69,6 +70,12 @@ class BatchedStageExecutor:
     ):
         self.cfg = cfg
         self.spec = spec
+        # Engine-side fused-QKV layout (models/transformer.fuse_qkv_layers:
+        # one projection matmul per layer, bitwise-identical outputs).
+        if isinstance(params, dict) and "layers" in params:
+            from ..models.transformer import fuse_qkv_layers
+
+            params = dict(params, layers=fuse_qkv_layers(params["layers"]))
         self.params = params
         self.slots = slots
         self.max_len = max_len
@@ -128,7 +135,7 @@ class BatchedStageExecutor:
     def _build_prefill(self):
         cfg, spec = self.cfg, self.spec
 
-        @partial(jax.jit, donate_argnums=(3, 4))
+        @partial(jax.jit, donate_argnums=engine_donation(3, 4))
         def fn(params, x, slot, k_all, v_all, t_real):
             b = 1
             t = x.shape[1]
@@ -244,7 +251,7 @@ class BatchedStageExecutor:
         S = self.slots
         T = t_step
 
-        @partial(jax.jit, donate_argnums=(4, 5))
+        @partial(jax.jit, donate_argnums=engine_donation(4, 5))
         def fn(params, x, lengths, active, k_all, v_all):
             # x: ids [S, T] or hidden [S, T, D]; lengths/active: [S].
             offs = jnp.arange(T, dtype=jnp.int32)

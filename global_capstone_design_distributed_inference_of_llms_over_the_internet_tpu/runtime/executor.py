@@ -45,6 +45,7 @@ from ..models.partition import (
 )
 from ..ops.sampling import RECENT_WINDOW, sample_token
 from ..models.transformer import stack_forward_train
+from ..utils.platform import engine_donation
 from .kv_cache import AllocationFailed, KVArena, KVHandle, round_to_bucket
 from .messages import (
     BackwardRequest,
@@ -214,6 +215,17 @@ class StageExecutor:
             self.params = jax.tree.map(
                 lambda a: jax.device_put(a, host), params)
             params = self.params
+        if (tp_mesh is None and not offload and isinstance(params, dict)
+                and "layers" in params):
+            # Engine-side fused-QKV layout (one projection matmul per
+            # layer; bitwise-identical — models/transformer.fuse_qkv_layers).
+            # TP keeps the canonical split (its shard boundaries must align
+            # per-projection); offload keeps it (host-streaming layer trees
+            # are keyed to the stored layout).
+            from ..models.transformer import fuse_qkv_layers
+
+            self.params = params = dict(
+                params, layers=fuse_qkv_layers(params["layers"]))
         self.cache_dtype = jnp.dtype(cache_dtype)
         kv_sharding = None
         tp_degree = 1
@@ -291,10 +303,10 @@ class StageExecutor:
 
             step = make_tp_stage_fn(
                 cfg, sub_spec, self.tp_mesh, self.tp_axis,
-                donate_cache=True,
+                donate_cache=bool(engine_donation(0)),
             )(sub_params)
         else:
-            @partial(jax.jit, donate_argnums=(2, 3))
+            @partial(jax.jit, donate_argnums=engine_donation(2, 3))
             def step(params, x, k_cache, v_cache, cache_len):
                 return stage_forward(cfg, sub_spec, params, x, k_cache,
                                      v_cache, cache_len)

@@ -381,6 +381,52 @@ class _FramedTcpServer:
 # Stage server
 # ---------------------------------------------------------------------------
 
+class RequestLog:
+    """Structured per-request records (the reference's ``_log_request``,
+    ``petals/server/handler.py:549-573``, which logs
+    ``method(blocks=a:b, remote_peer=...xxxxxx)`` per RPC — exceeded here:
+    every record carries verb, session, peer address, request size,
+    duration, and outcome, goes to the ``...request_log`` logger as a
+    greppable key=value line, AND lands in a bounded ring surfaced by the
+    ``info`` verb so an operator can ask a live server for its recent
+    traffic without log access)."""
+
+    def __init__(self, capacity: int = 256, name: str = "request_log"):
+        from collections import deque
+
+        self._ring = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._logger = logging.getLogger(f"{__name__}.{name}")
+
+    def record(self, verb: str, *, session: Optional[str] = None,
+               peer: str = "?", tokens: Optional[int] = None,
+               cur: Optional[int] = None, dur_ms: Optional[float] = None,
+               outcome: str = "ok", detail: Optional[str] = None) -> None:
+        rec = {"t": time.time(), "verb": verb, "peer": peer,
+               "outcome": outcome}
+        if session is not None:
+            rec["session"] = session
+        if tokens is not None:
+            rec["tokens"] = int(tokens)
+        if cur is not None:
+            rec["cur"] = int(cur)
+        if dur_ms is not None:
+            rec["dur_ms"] = round(float(dur_ms), 2)
+        if detail:
+            rec["detail"] = str(detail)[:200]
+        with self._lock:
+            self._ring.append(rec)
+        line = " ".join(f"{k}={v}" for k, v in rec.items() if k != "t")
+        if outcome == "ok":
+            self._logger.info(line)
+        else:
+            self._logger.warning(line)
+
+    def tail(self, n: int = 20) -> list:
+        with self._lock:
+            return list(self._ring)[-n:]
+
+
 class TcpStageServer(_FramedTcpServer):
     """Serves one StageExecutor over TCP (the ``StageConnectionHandler``
     role, ``src/rpc_handler.py:43``).
@@ -428,6 +474,9 @@ class TcpStageServer(_FramedTcpServer):
         self._streams_lock = threading.Lock()
         self.stream_opens = 0      # observability: full-metadata (re)opens
         self.stream_steps = 0      # observability: delta-only steps
+        # Structured per-request records (_log_request parity; the ring's
+        # tail rides the info verb).
+        self.request_log = RequestLog()
         # Several stage servers on one host may SHARE one runtime (one chip,
         # one compute thread): only the owner may start/stop it, otherwise an
         # elastic teardown of server A would kill server B's compute.
@@ -592,9 +641,15 @@ class TcpStageServer(_FramedTcpServer):
                 self._compute("inference", ex.drop_session,
                               header["session_id"])
             except (StageExecutionError, TaskRejected, TimeoutError) as exc:
+                self.request_log.record("end_session",
+                                        session=header["session_id"],
+                                        outcome="stage_error",
+                                        detail=str(exc))
                 _send_frame(sock, {"verb": "error", "message": str(exc),
                                    "kind": "stage"})
                 return
+            self.request_log.record("end_session",
+                                    session=header["session_id"])
             _send_frame(sock, {"verb": "ok"})
         elif verb == "info":
             spec = ex.spec
@@ -611,6 +666,10 @@ class TcpStageServer(_FramedTcpServer):
             steps = getattr(getattr(ex, "inner", None), "decode_steps", None)
             if steps is not None:
                 frame["decode_steps"] = steps
+            # Structured recent-request tail (_log_request parity): the
+            # operator's first question about a misbehaving server is "what
+            # has it been serving" — answerable over the wire.
+            frame["recent_requests"] = self.request_log.tail(20)
             _send_frame(sock, frame)
         else:
             _send_frame(sock, {"verb": "error",
@@ -708,6 +767,19 @@ class TcpStageServer(_FramedTcpServer):
     def _run_forward(self, sock, ex, req: StageRequest, stream: dict = None,
                      step_timeout: Optional[float] = None) -> None:
         t_req = time.monotonic()
+
+        def _log(outcome, detail=None):
+            try:
+                peer = "%s:%s" % sock.getpeername()[:2]
+            except OSError:
+                peer = "?"
+            self.request_log.record(
+                "prefill" if req.is_prefill else "forward",
+                session=req.session_id, peer=peer, tokens=req.seq_len,
+                cur=req.cur_len,
+                dur_ms=(time.monotonic() - t_req) * 1e3,
+                outcome=outcome, detail=detail)
+
         try:
             resp = self._compute("inference", ex.forward, req,
                                  size=req.seq_len, timeout=step_timeout)
@@ -718,6 +790,7 @@ class TcpStageServer(_FramedTcpServer):
         # an OSError subclass, and the outer handler's socket-error catch
         # would otherwise silently drop the connection.
         except (StageExecutionError, TaskRejected) as exc:
+            _log("stage_error", str(exc))
             _send_frame(sock, {"verb": "error", "message": str(exc),
                                "kind": "stage",
                                "peer": ex.peer_id})
@@ -725,11 +798,13 @@ class TcpStageServer(_FramedTcpServer):
         except TimeoutError:
             budget = (step_timeout if step_timeout is not None
                       else self.compute_timeout)
+            _log("timeout")
             _send_frame(sock, {"verb": "error", "kind": "stage",
                                "peer": ex.peer_id,
                                "message": f"stage compute timed out after "
                                           f"{budget:.0f}s"})
             return
+        _log("ok")
         if resp.is_token:
             if stream is not None and resp.token_id is not None:
                 # Maintain the stream's server-side recent-token window

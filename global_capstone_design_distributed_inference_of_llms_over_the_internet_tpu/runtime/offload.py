@@ -28,6 +28,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..utils.platform import engine_donation
 from ..models.config import ModelConfig
 from ..models.partition import StageSpec
 from ..models.transformer import (
@@ -90,7 +91,7 @@ class OffloadedSpanRunner:
             for k, v in params.items() if k != "layers"
         }
 
-        @functools.partial(jax.jit, donate_argnums=(3, 4))
+        @functools.partial(jax.jit, donate_argnums=engine_donation(3, 4))
         def _layer(lp, x, rope, k_all, v_all, idx, cache_len):
             kc = jax.lax.dynamic_index_in_dim(k_all, idx, 0, keepdims=False)
             vc = jax.lax.dynamic_index_in_dim(v_all, idx, 0, keepdims=False)

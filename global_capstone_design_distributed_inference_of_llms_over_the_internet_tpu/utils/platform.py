@@ -35,3 +35,27 @@ def force_cpu_devices(n: int, hard: bool = False) -> None:
     except Exception:
         if hard:
             raise
+
+
+def engine_donation(*idx: int):
+    """Donation indices for ENGINE jits that can be DISPATCHED FROM
+    CONCURRENT THREADS (serving adapters hold locks around their own
+    calls, but other threads in the process — client-side executors,
+    co-hosted servers — dispatch other programs at the same time).
+
+    On the CPU backend donation is DISABLED: measured round 4, the
+    long-standing "load-correlated token corruption" flake (rounds 2-4;
+    wrong tokens in concurrent-engine tests, a different test each run,
+    never reproducible standalone) A/B'd to donation — 8 consecutive
+    clean full-file runs with donate_argnums stripped vs a ~2/3 per-run
+    failure rate with it, same machine, idle. Donated-buffer reuse under
+    concurrent dispatch on the XLA CPU client can hand a still-referenced
+    buffer to the donating program; the corrupted reader is whichever
+    computation raced it, which is exactly the observed
+    any-test-any-run signature. TPU keeps donation: dispatch runs through
+    a different client where the race has never been observed, and HBM
+    headroom is the entire point of donating serving caches.
+    """
+    import jax
+
+    return idx if jax.default_backend() != "cpu" else ()

@@ -37,6 +37,34 @@ jax.config.update("jax_default_matmul_precision", "highest")
 # suite-time cost was marginal (~10%).
 jax.config.update("jax_enable_compilation_cache", False)
 
+# Synchronous CPU dispatch: XLA:CPU's default ASYNC dispatch executes each
+# computation on a background thread while the caller proceeds — combined
+# with buffer frees (donation, or GC of a previous test's engines) and the
+# serving engines' multi-threaded callers, this is the measured corruption
+# mechanism behind the rounds-2-4 "load-correlated" token flake (see the
+# quarantine note below for the A/B evidence ladder). Synchronous dispatch
+# removes the race class wholesale on the test rig; TPU dispatch is
+# unaffected (different client).
+jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+
+# Diagnostic switch (flake triage): NO_DONATE=1 strips donate_argnums from
+# every jax.jit so buffer donation is off suite-wide — used to discriminate
+# whether the in-file batching corruption is a donation/concurrent-dispatch
+# interaction. Not for normal runs (donation is a real memory optimization).
+import os  # noqa: E402
+
+if os.environ.get("NO_DONATE"):
+    _orig_jit = jax.jit
+
+    def _no_donate_jit(*args, **kwargs):
+        kwargs.pop("donate_argnums", None)
+        kwargs.pop("donate_argnames", None)
+        return _orig_jit(*args, **kwargs)
+
+    jax.jit = _no_donate_jit
+    print("[conftest] NO_DONATE=1: jax.jit donation stripped suite-wide")
+
 
 @pytest.fixture(scope="session")
 def rng():
@@ -119,16 +147,26 @@ def pytest_collection_modifyitems(config, items):
 # ---------------------------------------------------------------------------
 # Parity-flake quarantine with teeth (VERDICT r2 item 6).
 #
-# Token-parity tests on this box occasionally fail under heavy CONCURRENT
-# host load with corrupted results — a DIFFERENT deterministic test each
-# time, never reproducible in isolation (evidence campaign: commits
-# c82adcf/8a00756; once including a segfault inside backend_compile).
-# Round-4 addendum: one recurrence fired in the compile-densest shard at
-# only ~19k/65k memory maps on a nominally idle box (clean 4/4 standalone
-# and clean on a full shard re-run) — so the round-3 vm.max_map_count
-# root-cause is INCOMPLETE; per-process compile density correlates even
-# away from the map cap. Mitigation: the dense shard is split
-# (scripts/run_tests.py); the rule below still applies.
+# Token-parity tests on this box occasionally failed with corrupted
+# results — a DIFFERENT deterministic test each time, never reproducible
+# in isolation (evidence campaign: commits c82adcf/8a00756; once including
+# a segfault inside backend_compile).
+# ROOT-CAUSED round 4 (superseding the round-3 map-count story, which
+# explained the segfault regime but not recurrences at ~19k/65k maps on an
+# idle box): **XLA:CPU ASYNC DISPATCH racing buffer frees under the
+# engines' multi-threaded callers** — donation amplifies it (explicit
+# early frees), GC of previous tests' engine buffers suffices (which is
+# why it only ever fired in-file/in-suite, never standalone). Evidence
+# ladder, all on the worst file (test_batching.py, ~3.5 min/run, idle
+# box): async+donation ~2/3 runs dirty; async+donation-gated 2/6 dirty;
+# async+donation-stripped 0/4; SYNC dispatch 0/5 (and no measurable
+# slowdown). Fixes: (1) synchronous CPU dispatch suite-wide (above) kills
+# the race class on the test rig; (2) utils.platform.engine_donation
+# keeps donation OFF on the CPU backend in every thread-exposed engine
+# (production CPU hosts run async) — TPU keeps donation (different
+# client, race never observed, HBM headroom is donation's purpose). The
+# quarantine below stays as a TRIPWIRE: with the fixes in, any parity
+# rerun is a signal, not weather.
 # The triage rule, mechanized: a test marked `parity` that fails is RERUN ONCE,
 # immediately, in-process. A deterministic logic bug fails both runs and the
 # suite stays red; load-induced corruption passes the rerun and the suite

@@ -241,3 +241,44 @@ def test_non_llama_rope_scaling_rejected():
         rope_scaling={"rope_type": "yarn", "factor": 4.0})
     with _pytest.raises(ValueError, match="rope_scaling"):
         config_from_hf(hf_cfg)
+
+
+def test_fused_qkv_layers_bitwise_matches_canonical():
+    """Engine-side fused-QKV layout (models/transformer.fuse_qkv_layers):
+    one [D, (H+2Hkv)*Dh] projection must be BITWISE identical to the three
+    canonical matmuls — fusing along the output axis never changes a
+    column's K-reduction — so every engine-vs-oracle parity test stays
+    exact with engines fused and oracles canonical."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+        full_forward,
+        init_kv_cache,
+        init_params,
+        llama_config,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.transformer import (
+        fuse_qkv_layers,
+    )
+
+    cfg = llama_config(vocab_size=211, hidden_size=64, num_layers=4,
+                       num_heads=4, num_kv_heads=2, intermediate_size=128,
+                       max_position_embeddings=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    fused = dict(params, layers=fuse_qkv_layers(params["layers"]))
+    assert "wqkv" in fused["layers"]["attn"]
+    assert "wq" not in fused["layers"]["attn"]
+    # idempotent / guard behavior
+    assert fuse_qkv_layers(fused["layers"]) is fused["layers"]
+
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 9)),
+        jnp.int32)
+    kc, vc = init_kv_cache(cfg, cfg.num_layers, 2, 32)
+    ref, kr, vr = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
+    kc2, vc2 = init_kv_cache(cfg, cfg.num_layers, 2, 32)
+    got, kg, vg = full_forward(cfg, fused, ids, kc2, vc2, jnp.int32(0))
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    np.testing.assert_array_equal(np.asarray(kr), np.asarray(kg))

@@ -494,3 +494,39 @@ def test_end_session_drops_stream_state(swarm):
     for srv in servers:
         live = sum(len(d) for d in srv._streams.values())
         assert live == 0, (srv.executor.peer_id, srv._streams)
+
+
+def test_structured_request_log_rides_info_verb(swarm):
+    """Per-request structured records (reference _log_request,
+    petals/server/handler.py:549-573, exceeded): after a generation, the
+    server's info verb returns a recent-request tail with verb/session/
+    duration/outcome fields, and failures are recorded with their detail."""
+    cfg, params, client, transport, servers, reg_server = swarm
+    rng = np.random.default_rng(5)
+    prompt = [int(t) for t in rng.integers(0, cfg.vocab_size, 8)]
+    client.generate(prompt, max_new_tokens=3,
+                    sampling=SamplingParams(temperature=0.0))
+
+    info = transport.info("tcp-s1-r0")
+    recent = info["recent_requests"]
+    assert recent, "info verb must surface the request ring"
+    verbs = {r["verb"] for r in recent}
+    assert "prefill" in verbs and "forward" in verbs
+    for r in recent:
+        assert r["outcome"] == "ok"
+        assert "dur_ms" in r and r["dur_ms"] >= 0
+        assert "session" in r and "peer" in r
+
+    # a refused request lands in the ring with its outcome + detail
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.messages import (
+        StageRequest,
+    )
+
+    with pytest.raises(StageExecutionError):
+        transport.call("tcp-s1-r0", StageRequest(
+            session_id="ghost", seq_len=1, cur_len=5, is_prefill=False,
+            max_length=16,
+            hidden=jnp.zeros((1, 1, cfg.hidden_size), jnp.float32)))
+    recent = transport.info("tcp-s1-r0")["recent_requests"]
+    errs = [r for r in recent if r["outcome"] != "ok"]
+    assert errs and "detail" in errs[-1]
