@@ -819,13 +819,13 @@ def main():
         sustained = None
     results["hbm_sustained_gbps"] = sustained
     from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.transformer import (
-        fuse_qkv_layers,
+        fuse_qkv_params,
     )
 
     gcfg = get_config("gpt2")
     gparams = init_params(jax.random.PRNGKey(0), gcfg, dtype=jnp.bfloat16)
     # Engine-side fused-QKV layout — what the serving engines actually run.
-    gparams = dict(gparams, layers=fuse_qkv_layers(gparams["layers"]))
+    gparams = fuse_qkv_params(gparams)
     results["gpt2_b8"] = bench_config(
         "gpt2_b8", gcfg, gparams, batch=8, max_len=512, s1=S1, s2=S2,
         sustained_gbps=sustained)
@@ -843,7 +843,7 @@ def main():
 
     fcfg = flagship_cfg()
     fparams = init_params(jax.random.PRNGKey(0), fcfg, dtype=jnp.bfloat16)
-    fparams = dict(fparams, layers=fuse_qkv_layers(fparams["layers"]))
+    fparams = fuse_qkv_params(fparams)
     results["flagship_1b_b1"] = bench_config(
         "flagship_1b_b1", fcfg, fparams, batch=1, max_len=512, s1=S1, s2=S2,
         sustained_gbps=sustained)

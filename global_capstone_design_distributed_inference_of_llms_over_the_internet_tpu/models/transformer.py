@@ -200,6 +200,22 @@ def fuse_qkv_layers(layers: Params) -> Params:
     return out
 
 
+def fuse_qkv_params(params: Params) -> Params:
+    """Engine-construction wrapper over `fuse_qkv_layers` for a whole param
+    tree (the one place the guard lives — five engines apply it).
+
+    Memory note: the fused leaf is a COPY; if the caller keeps its canonical
+    tree alive (e.g. one checkpoint feeding several engines), both layouts
+    stay resident — drop the caller-side reference after construction when
+    projection-weight residency matters."""
+    if not isinstance(params, dict) or "layers" not in params:
+        return params
+    fused = fuse_qkv_layers(params["layers"])
+    if fused is params["layers"]:
+        return params
+    return dict(params, layers=fused)
+
+
 def _mlp(cfg: ModelConfig, p: Params, x: jnp.ndarray, tp_axis: Optional[str]) -> jnp.ndarray:
     if cfg.is_moe:
         return _moe_mlp(cfg, p, x, tp_axis)

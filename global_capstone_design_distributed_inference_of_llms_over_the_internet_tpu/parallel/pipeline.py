@@ -246,6 +246,10 @@ class IciPipeline:
         body = _pipeline_body(cfg, num_stages, num_micro, tp_axis=tp_axis)
         spec_kv = _kv_spec(tp)
 
+        # Donation stays UNgated here (cf. utils.platform.engine_donation):
+        # the fused pipeline is a single-controller engine — one thread owns
+        # the mesh and every dispatch — so the CPU async-dispatch/free race
+        # the serving engines gate against has no second thread to race.
         @partial(jax.jit, donate_argnums=(3, 4))
         def step(embed_p, head_p, layers_p, k_all, v_all, ids, cache_len):
             m, b, t = ids.shape

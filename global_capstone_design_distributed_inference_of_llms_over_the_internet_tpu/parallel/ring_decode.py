@@ -206,6 +206,8 @@ class RingDecoder:
                                    pipe.layers_stacked)
         mesh = pipe.mesh
 
+        # Donation ungated: single-controller engine (see the rationale in
+        # parallel/pipeline.py step()).
         @partial(jax.jit, donate_argnums=(4, 5))
         def step(embed_p, head_p, layers_p, tokens0, k_all, v_all, lens, n):
             sharded = shard_map(

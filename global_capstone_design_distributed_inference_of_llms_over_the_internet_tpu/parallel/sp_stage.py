@@ -104,13 +104,12 @@ class SpStageRunner:
         self.tail_max = tail_max
         self.dtype = jnp.dtype(dtype)
         # Engine-side fused-QKV layout (one projection matmul per layer,
-        # bitwise-identical — models/transformer.fuse_qkv_layers); the sp
+        # bitwise-identical — models/transformer.fuse_qkv_params); the sp
         # axis shards the SEQUENCE, never the projections, so fusion is
         # always safe here.
-        if isinstance(params, dict) and "layers" in params:
-            from ..models.transformer import fuse_qkv_layers
+        from ..models.transformer import fuse_qkv_params
 
-            params = dict(params, layers=fuse_qkv_layers(params["layers"]))
+        params = fuse_qkv_params(params)
         # Replicate the span's params over the mesh once.
         repl = NamedSharding(mesh, P())
         self.params = jax.device_put(params, repl)

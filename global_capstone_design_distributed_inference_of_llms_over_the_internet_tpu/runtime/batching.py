@@ -70,13 +70,11 @@ class BatchedStageExecutor:
     ):
         self.cfg = cfg
         self.spec = spec
-        # Engine-side fused-QKV layout (models/transformer.fuse_qkv_layers:
-        # one projection matmul per layer, bitwise-identical outputs).
-        if isinstance(params, dict) and "layers" in params:
-            from ..models.transformer import fuse_qkv_layers
+        # Engine-side fused-QKV layout (one projection matmul per layer,
+        # bitwise-identical — models/transformer.fuse_qkv_params).
+        from ..models.transformer import fuse_qkv_params
 
-            params = dict(params, layers=fuse_qkv_layers(params["layers"]))
-        self.params = params
+        self.params = params = fuse_qkv_params(params)
         self.slots = slots
         self.max_len = max_len
         self.dtype = jnp.dtype(dtype)

@@ -215,17 +215,15 @@ class StageExecutor:
             self.params = jax.tree.map(
                 lambda a: jax.device_put(a, host), params)
             params = self.params
-        if (tp_mesh is None and not offload and isinstance(params, dict)
-                and "layers" in params):
+        if tp_mesh is None and not offload:
             # Engine-side fused-QKV layout (one projection matmul per
-            # layer; bitwise-identical — models/transformer.fuse_qkv_layers).
+            # layer; bitwise-identical — models/transformer.fuse_qkv_params).
             # TP keeps the canonical split (its shard boundaries must align
             # per-projection); offload keeps it (host-streaming layer trees
             # are keyed to the stored layout).
-            from ..models.transformer import fuse_qkv_layers
+            from ..models.transformer import fuse_qkv_params
 
-            self.params = params = dict(
-                params, layers=fuse_qkv_layers(params["layers"]))
+            self.params = params = fuse_qkv_params(params)
         self.cache_dtype = jnp.dtype(cache_dtype)
         kv_sharding = None
         tp_degree = 1

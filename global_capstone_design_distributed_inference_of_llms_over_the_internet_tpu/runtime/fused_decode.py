@@ -22,6 +22,11 @@ Two measured structural choices (slope-timed on a v5e, gpt2-124M b8 and a
     reduction — measured ~1.5x over the fp32-matmul + row-major argmax
     pair at gpt2's vocab.
 
+Donation stays ungated here (cf. utils.platform.engine_donation): both
+fused engines are single-controller programs — the bench/oracle caller
+owns every dispatch, so the CPU async-dispatch/free race the threaded
+serving engines gate against has no second thread to race.
+
 `make_fused_decode` is the greedy throughput engine (bench + oracle fast
 path); `make_fused_sample_decode` folds the FULL reference sampler into
 the scan for batch-1 sampled generation, bit-identical to the per-token

@@ -512,12 +512,20 @@ def test_structured_request_log_rides_info_verb(swarm):
     assert recent, "info verb must surface the request ring"
     verbs = {r["verb"] for r in recent}
     assert "prefill" in verbs and "forward" in verbs
+    assert all(r["outcome"] == "ok" for r in recent)
+    # compute verbs carry timing + request identity; lifecycle records
+    # (end_session) are identity-only
     for r in recent:
-        assert r["outcome"] == "ok"
-        assert "dur_ms" in r and r["dur_ms"] >= 0
-        assert "session" in r and "peer" in r
+        if r["verb"] in ("prefill", "forward"):
+            assert "dur_ms" in r and r["dur_ms"] >= 0
+            assert "session" in r and "peer" in r
 
     # a refused request lands in the ring with its outcome + detail
+    import jax.numpy as jnp
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.executor import (
+        StageExecutionError,
+    )
     from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.messages import (
         StageRequest,
     )
