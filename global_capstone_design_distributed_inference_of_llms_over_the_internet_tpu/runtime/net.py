@@ -401,7 +401,8 @@ class RequestLog:
     def record(self, verb: str, *, session: Optional[str] = None,
                peer: str = "?", tokens: Optional[int] = None,
                cur: Optional[int] = None, dur_ms: Optional[float] = None,
-               outcome: str = "ok", detail: Optional[str] = None) -> None:
+               outcome: str = "ok", detail: Optional[str] = None,
+               **fields) -> None:
         rec = {"t": time.time(), "verb": verb, "peer": peer,
                "outcome": outcome}
         if session is not None:
@@ -414,13 +415,17 @@ class RequestLog:
             rec["dur_ms"] = round(float(dur_ms), 2)
         if detail:
             rec["detail"] = str(detail)[:200]
+        rec.update({k: v for k, v in fields.items() if v is not None})
         with self._lock:
             self._ring.append(rec)
         line = " ".join(f"{k}={v}" for k, v in rec.items() if k != "t")
-        if outcome == "ok":
-            self._logger.info(line)
-        else:
+        if outcome != "ok":
             self._logger.warning(line)
+        elif verb == "forward":
+            # steady-state decode steps must not flood serving logs
+            self._logger.debug(line)
+        else:
+            self._logger.info(line)
 
     def tail(self, n: int = 20) -> list:
         with self._lock:
@@ -624,7 +629,8 @@ class TcpStageServer(_FramedTcpServer):
             self._stream_step(sock, ex, header, payload)
             return
         if verb == "forward":
-            self._run_forward(sock, ex, _header_to_request(header, payload))
+            self._run_forward(sock, ex, _header_to_request(header, payload),
+                              resp_wire_dtype=header.get("wire_dtype"))
         elif verb in ("train_forward", "backward"):
             self._train_verbs(sock, ex, verb, header, payload)
         elif verb == "end_session":
@@ -711,6 +717,9 @@ class TcpStageServer(_FramedTcpServer):
             "step_timeout": header.get("step_timeout"),
             "deadline": (time.monotonic() + header["deadline_s"]
                          if header.get("deadline_s") else None),
+            # Negotiated response precision for this session (absent ->
+            # the server's default).
+            "wire_dtype": header.get("wire_dtype"),
         }
         with self._streams_lock:
             self._streams.setdefault(sock, {})[sid] = state
@@ -765,8 +774,12 @@ class TcpStageServer(_FramedTcpServer):
                           step_timeout=state["step_timeout"])
 
     def _run_forward(self, sock, ex, req: StageRequest, stream: dict = None,
-                     step_timeout: Optional[float] = None) -> None:
+                     step_timeout: Optional[float] = None,
+                     resp_wire_dtype: Optional[str] = None) -> None:
         t_req = time.monotonic()
+        if resp_wire_dtype is None and stream is not None:
+            resp_wire_dtype = stream.get("wire_dtype")
+        resp_wire_dtype = resp_wire_dtype or self.wire_dtype
 
         def _log(outcome, detail=None):
             try:
@@ -778,7 +791,9 @@ class TcpStageServer(_FramedTcpServer):
                 session=req.session_id, peer=peer, tokens=req.seq_len,
                 cur=req.cur_len,
                 dur_ms=(time.monotonic() - t_req) * 1e3,
-                outcome=outcome, detail=detail)
+                outcome=outcome, detail=detail,
+                span=f"[{req.start_block},{req.end_block})",
+                replay=int(req.is_replay) or None)
 
         try:
             resp = self._compute("inference", ex.forward, req,
@@ -804,7 +819,6 @@ class TcpStageServer(_FramedTcpServer):
                                "message": f"stage compute timed out after "
                                           f"{budget:.0f}s"})
             return
-        _log("ok")
         if resp.is_token:
             if stream is not None and resp.token_id is not None:
                 # Maintain the stream's server-side recent-token window
@@ -864,27 +878,20 @@ class TcpStageServer(_FramedTcpServer):
             _send_frame(sock, rh, rp)
         else:
             arr = np.asarray(resp.hidden)
-            meta, body = _encode_tensor(arr, self.wire_dtype)
+            meta, body = _encode_tensor(arr, resp_wire_dtype)
             _send_frame(sock, {
                 "verb": "hidden", "session_id": resp.session_id,
                 "cache_len": resp.cache_len, "tensor": meta,
             }, body)
         # Structured per-request record (petals _log_request,
-        # handler.py:549-573): prefills at INFO, per-token decode steps
-        # at DEBUG so steady-state serving doesn't flood logs. Logged
-        # AFTER the response is encoded+sent: JAX dispatch is async, so
-        # only then has the device work for hidden-returning stages
-        # actually materialized — ms covers real compute, not dispatch.
-        logger.log(
-            logging.INFO if req.is_prefill else logging.DEBUG,
-            "req peer=%s session=%s kind=%s span=[%s,%s) T=%d B=%d "
-            "replay=%d ms=%.1f",
-            ex.peer_id, req.session_id,
-            "prefill" if req.is_prefill else "decode",
-            req.start_block, req.end_block, req.seq_len,
-            req.hidden.shape[0], int(req.is_replay),
-            (time.monotonic() - t_req) * 1e3,
-        )
+        # handler.py:549-573 parity, exceeded: RequestLog also keeps the
+        # bounded ring the info verb surfaces, and errors are recorded at
+        # the failure sites above). Logged AFTER the response is
+        # encoded+sent: JAX dispatch is async, so only then has the device
+        # work for hidden-returning stages actually materialized — dur_ms
+        # covers real compute, not dispatch. Decode-ok records go to the
+        # logger at DEBUG so steady-state serving doesn't flood logs.
+        _log("ok")
 
     def _train_verbs(self, sock, ex, verb: str, header: dict,
                      payload: bytes) -> None:
@@ -1091,8 +1098,15 @@ class TcpTransport(Transport):
             else:
                 arr = np.asarray(request.hidden)
                 meta, body = _encode_tensor(arr, self.wire_dtype)
-                _send_frame(sock, self._tagged(_request_header(request, meta)),
-                            body)
+                hdr = _request_header(request, meta)
+                # Per-session wire negotiation (reference parity: its
+                # schema carries a per-tensor compression choice,
+                # petals/server/handler.py:411-432): the client asks the
+                # server to encode RESPONSES at the client's precision —
+                # an f32 client keeps exact activations from a
+                # bf16-default server.
+                hdr["wire_dtype"] = self.wire_dtype
+                _send_frame(sock, self._tagged(hdr), body)
             header, payload = _recv_frame(sock)
         except socket.timeout as exc:
             self._drop(peer_id)
@@ -1138,6 +1152,7 @@ class TcpTransport(Transport):
                     "next_servers": list(request.next_servers),
                     "step_timeout": self.step_timeout,
                     "deadline_s": self.session_deadline_s,
+                    "wire_dtype": self.wire_dtype,
                 }
                 _send_frame(sock, self._tagged(open_hdr))
                 h, _ = _recv_frame(sock)

@@ -538,3 +538,46 @@ def test_structured_request_log_rides_info_verb(swarm):
     recent = transport.info("tcp-s1-r0")["recent_requests"]
     errs = [r for r in recent if r["outcome"] != "ok"]
     assert errs and "detail" in errs[-1]
+
+
+def test_wire_dtype_negotiation_f32_client_exact_over_bf16_server():
+    """Per-session wire negotiation (reference parity: per-tensor
+    compression choice in the serving schema, handler.py:411-432): an f32
+    client against a bf16-DEFAULT server negotiates f32 responses, so the
+    generation is token-identical to the oracle — without negotiation the
+    server's bf16 response encoding would distort intermediate activations."""
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("4"))
+
+    reg = RegistryServer()
+    reg.start()
+    ex = StageExecutor(cfg, plan.stages[1],
+                       slice_stage_params(cfg, params, plan.stages[1]),
+                       peer_id="nego-srv")
+    srv = TcpStageServer(ex, wire_dtype="bf16")      # server DEFAULT: bf16
+    srv.start()
+    rec = make_server_record("nego-srv", plan.stages[1])
+    rec.address = srv.address
+    reg.registry.register(rec)
+    registry = RemoteRegistry(reg.address)
+    transport = TcpTransport(registry, wire_dtype="f32")   # client wants f32
+    stage0 = StageExecutor(cfg, plan.stages[0],
+                           slice_stage_params(cfg, params, plan.stages[0]),
+                           peer_id="client")
+    client = PipelineClient(cfg, plan, stage0, transport, registry,
+                            settle_seconds=0.0)
+    try:
+        rng = np.random.default_rng(9)
+        prompt = [int(t) for t in rng.integers(0, cfg.vocab_size, 10)]
+        sampling = SamplingParams(temperature=0.0)
+        got = client.generate(prompt, max_new_tokens=6,
+                              sampling=sampling).tokens
+        ref = oracle_generate(cfg, params, prompt, 6, sampling)
+        assert got == ref, (
+            "negotiated f32 responses must make the bf16-default server "
+            "token-exact for an f32 client")
+    finally:
+        transport.close()
+        srv.stop()
+        reg.stop()
