@@ -234,12 +234,17 @@ def run_local(args, cfg: ModelConfig, params) -> int:
 
 
 def run_fused(args, cfg: ModelConfig, params) -> int:
-    """Fused ICI pipeline generation (microbatch=1 stream for the CLI)."""
+    """Fused ICI pipeline generation (microbatch=1 stream for the CLI), or
+    — with ``--ring_sessions G`` — G concurrent generations on the
+    multi-session ring-decode schedule (every stage advances a different
+    session each tick; see parallel.ring_decode)."""
     from .parallel.pipeline import IciPipeline
 
     num_stages = args.num_stages or max(1, min(len(jax.devices()) // args.tp, 4))
     while cfg.num_layers % num_stages:
         num_stages -= 1
+    if getattr(args, "ring_sessions", 0) > 1:
+        return _run_fused_ring(args, cfg, params, num_stages)
     pipe = IciPipeline.build(cfg, params, num_stages=num_stages,
                              num_micro=1, tp=args.tp)
     logger.info("fused pipeline: %d stages x tp=%d on %s",
@@ -407,6 +412,99 @@ def run_oracle(args, cfg: ModelConfig, params) -> int:
 
     return _generate_and_report(args, generate, cfg,
                                 supports_speculative=False)
+
+
+def _run_fused_ring(args, cfg: ModelConfig, params, num_stages: int) -> int:
+    """`--mode fused --ring_sessions G`: serve G concurrent prompts
+    ('||'-separated in --prompt; a single prompt is replicated) with the
+    bubble-free rotation schedule. Each session prefills its own length
+    via the masked single-group prefill, then all G decode together — one
+    sampled token per tick in steady state instead of one per S ticks.
+    Greedy (the fused sampler contract matches --mode fused)."""
+    from .parallel.pipeline import IciPipeline
+    from .parallel.ring_decode import RingDecoder, make_ring_prefill_group
+
+    G = args.ring_sessions
+    if G < num_stages:
+        raise SystemExit(
+            f"--ring_sessions {G} < pipeline stages {num_stages}: the "
+            "rotation needs at least one session per stage "
+            "(use --num_stages to shrink the pipeline)")
+    tokenizer = load_tokenizer(_remote_store(args).cache_dir
+                               if _is_remote(args.checkpoint)
+                               else args.checkpoint)
+    prompts = [p for p in args.prompt.split("||") if p.strip()] or ["hi"]
+    while len(prompts) < G:
+        prompts.append(prompts[len(prompts) % max(1, len(prompts))])
+    prompts = prompts[:G]
+    prompt_ids = [[i % cfg.vocab_size for i in tokenizer.encode(p)]
+                  for p in prompts]
+    eos = getattr(tokenizer, "eos_token_id", None)
+    if args.temperature > 0:
+        logger.warning("ring decode samples greedily (temperature ignored)")
+
+    pipe = IciPipeline.build(cfg, params, num_stages=num_stages,
+                             num_micro=G, tp=args.tp)
+    logger.info("ring decode: %d sessions over %d stages x tp=%d",
+                G, num_stages, args.tp)
+    chunk = 16
+    rd = RingDecoder.build(pipe, max_steps=chunk)
+    prefill_one = make_ring_prefill_group(pipe)
+    # chunk-1 of overshoot headroom: a session finishing mid-chunk still
+    # has its (discarded) extra steps' KV writes land in-bounds.
+    max_len = max(len(p) for p in prompt_ids) + args.max_new_tokens + chunk
+    k, v = pipe.init_kv(1, max(128, max_len), dtype=pipe.embed["wte"].dtype)
+
+    t0 = time.monotonic()
+    lens = np.zeros((G,), np.int32)
+    tok0 = np.zeros((G, 1), np.int32)
+    for g, ids_g in enumerate(prompt_ids):
+        first, k, v = prefill_one(jnp.asarray([ids_g], jnp.int32), k, v, g)
+        tok0[g] = np.asarray(first)
+        lens[g] = len(ids_g)
+    ttft = time.monotonic() - t0
+
+    sessions = [[int(tok0[g, 0])] for g in range(G)]
+    done = [False] * G
+    cur_tok = jnp.asarray(tok0)
+    lens_j = jnp.asarray(lens)
+    t0 = time.monotonic()
+    produced = G
+    while True:
+        act = [g for g in range(G)
+               if not done[g] and len(sessions[g]) < args.max_new_tokens]
+        if not act:
+            break
+        n = max(1, min(chunk, max(args.max_new_tokens - len(sessions[g])
+                                  for g in act)))
+        toks, k, v = rd.decode(cur_tok, k, v, lens_j, n)
+        toks = np.asarray(toks[:n])
+        for g in range(G):
+            for i in range(n):
+                if done[g] or len(sessions[g]) >= args.max_new_tokens:
+                    done[g] = True
+                    break
+                t = int(toks[i, g, 0])
+                sessions[g].append(t)
+                produced += 1
+                if eos is not None and t == eos:
+                    done[g] = True
+                elif (len(sessions[g]) >= 5
+                      and len(set(sessions[g][-5:])) == 1):
+                    done[g] = True
+        cur_tok = jnp.asarray(toks[n - 1])
+        lens_j = lens_j + n
+    decode_s = time.monotonic() - t0
+
+    for g, toks_g in enumerate(sessions):
+        text = tokenizer.decode(toks_g[:args.max_new_tokens])
+        print(f"\n=== Session {g} ({len(toks_g[:args.max_new_tokens])} "
+              f"tokens) ===\n{text}")
+    print(f"\nTTFT (all {G} prefills): {ttft:.3f}s")
+    rate = produced / decode_s if decode_s > 0 else 0.0
+    print(f"Decode: {decode_s:.3f}s total, {rate:.2f} tokens/s aggregate "
+          f"across {G} sessions")
+    return 0
 
 
 def _generate_and_report(args, generate_fn, cfg: ModelConfig,
@@ -845,6 +943,13 @@ def build_parser() -> argparse.ArgumentParser:
     # TPU-native knobs
     p.add_argument("--num_stages", type=int, default=None,
                    help="fused mode: pipeline depth (default: #devices, <=4)")
+    p.add_argument("--ring_sessions", type=int, default=0,
+                   help="fused mode: serve this many CONCURRENT sessions "
+                        "('||'-separated --prompt) on the multi-session "
+                        "ring-decode schedule — every stage advances a "
+                        "different session each tick, so steady-state "
+                        "decode has no pipeline bubble (needs >= "
+                        "num_stages sessions)")
     p.add_argument("--tp", type=int, default=1,
                    help="fused/serve mode: tensor parallelism per stage "
                         "(serve: the stage step is sharded over a local "

@@ -38,6 +38,8 @@ def test_byte_tokenizer_roundtrip():
     ["--mode", "oracle"],
     ["--mode", "fused", "--num_stages", "2"],
     ["--mode", "fused", "--tp", "2", "--num_stages", "2"],
+    ["--mode", "fused", "--num_stages", "2", "--ring_sessions", "3",
+     "--prompt", "hi||there||you"],
 ])
 def test_cli_modes_run(mode_args, capsys):
     rc = main(mode_args + [
