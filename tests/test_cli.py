@@ -51,6 +51,32 @@ def test_cli_modes_run(mode_args, capsys):
     assert "TTFT" in out and "tokens/s" in out
 
 
+def test_ring_sessions_cli_matches_single_session_fused(capsys):
+    """--ring_sessions must be a SCHEDULING change only: each session's
+    text equals what single-session fused mode generates for its prompt."""
+    common = ["--model", "gpt2", "--max_new_tokens", "4",
+              "--temperature", "0"]
+    singles = []
+    for p in ("hi", "yo"):
+        rc = main(["--mode", "fused", "--num_stages", "2",
+                   "--prompt", p] + common)
+        assert rc == 0 or rc is None
+        out = capsys.readouterr().out
+        gen = out.split("===")[1:]           # "Generation (...)" block
+        text = out.split("===")[2].splitlines()[1]
+        singles.append(text)
+
+    rc = main(["--mode", "fused", "--num_stages", "2",
+               "--ring_sessions", "2", "--prompt", "hi||yo"] + common)
+    assert rc == 0 or rc is None
+    out = capsys.readouterr().out
+    blocks = out.split("=== Session ")[1:]
+    ring_texts = [b.splitlines()[1] for b in blocks]
+    assert ring_texts == singles, (
+        f"ring sessions diverged from single-session fused: "
+        f"{ring_texts} vs {singles}")
+
+
 def test_status_mode_coverage_summary(capsys):
     """--mode status prints live records + the per-block coverage summary
     (the reference's get_remote_module_infos log, src/dht_utils.py:227-240)

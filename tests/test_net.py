@@ -469,7 +469,21 @@ def test_stream_per_step_timeout_enforced_via_runtime():
             session_id="ok", hidden=h, seq_len=3, cur_len=0,
             is_prefill=True, max_length=16))
         ok_tx.close()
-        # step_timeout so small the first (compiling) step can't make it.
+        # Deterministic slowness: wrap forward with a sleep far past the
+        # budget. (The old version relied on "the first step compiles
+        # slowly", but the ok-call above already warmed this executor and
+        # a warm tiny-model step can beat 5 ms under synchronous CPU
+        # dispatch — the enforcement plumbing, not wall-clock luck, is
+        # what this test pins.)
+        import time as _time
+
+        orig_forward = ex.forward
+
+        def slow_forward(req):
+            _time.sleep(0.2)
+            return orig_forward(req)
+
+        ex.forward = slow_forward
         to_tx = TcpTransport(registry, wire_dtype="f32",
                              step_timeout=0.005)
         with pytest.raises(StageExecutionError, match="timed out"):
