@@ -434,8 +434,9 @@ def _run_fused_ring(args, cfg: ModelConfig, params, num_stages: int) -> int:
                                if _is_remote(args.checkpoint)
                                else args.checkpoint)
     prompts = [p for p in args.prompt.split("||") if p.strip()] or ["hi"]
+    orig = len(prompts)  # cycle over the USER's prompts, not the grown list
     while len(prompts) < G:
-        prompts.append(prompts[len(prompts) % max(1, len(prompts))])
+        prompts.append(prompts[len(prompts) % orig])
     prompts = prompts[:G]
     prompt_ids = [[i % cfg.vocab_size for i in tokenizer.encode(p)]
                   for p in prompts]
@@ -469,7 +470,9 @@ def _run_fused_ring(args, cfg: ModelConfig, params, num_stages: int) -> int:
     cur_tok = jnp.asarray(tok0)
     lens_j = jnp.asarray(lens)
     t0 = time.monotonic()
-    produced = G
+    # Count only tokens harvested INSIDE the decode loop: the first token
+    # per session came from prefill (its cost sits in TTFT, not here).
+    produced = 0
     while True:
         act = [g for g in range(G)
                if not done[g] and len(sessions[g]) < args.max_new_tokens]
@@ -503,7 +506,8 @@ def _run_fused_ring(args, cfg: ModelConfig, params, num_stages: int) -> int:
     print(f"\nTTFT (all {G} prefills): {ttft:.3f}s")
     rate = produced / decode_s if decode_s > 0 else 0.0
     print(f"Decode: {decode_s:.3f}s total, {rate:.2f} tokens/s aggregate "
-          f"across {G} sessions")
+          f"across {G} sessions (decode-loop tokens only; each session's "
+          f"first token comes from prefill)")
     return 0
 
 
