@@ -155,6 +155,7 @@ def stage_forward(
     v_caches: jnp.ndarray,
     cache_len: jnp.ndarray,
     tp_axis: Optional[str] = None,
+    prompts: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Uniform stage forward, role-dispatched.
 
@@ -163,6 +164,10 @@ def stage_forward(
     ``src/llama_partition.py:99-137,222-297,391-474``). Returns
     (hidden-or-logits, new k_caches, new v_caches). Positions are derived from
     cache_len exactly like reference ``src/utils.py:40-48``.
+
+    prompts: optional [span_layers, pre_seq, D] inference-time deep prompts
+    added at each block's entry (``petals/server/block_functions.py:57-65,
+    171-226`` — the ptune serving path).
     """
     if spec.is_first:
         b, t = inputs.shape
@@ -176,7 +181,7 @@ def stage_forward(
     if spec.num_layers > 0:
         x, k_caches, v_caches = stack_forward(
             cfg, params["layers"], x, positions, k_caches, v_caches, cache_len,
-            tp_axis=tp_axis,
+            tp_axis=tp_axis, prompts=prompts,
         )
 
     if spec.is_last:

@@ -356,6 +356,28 @@ def layer_forward(
     return x, k_cache, v_cache
 
 
+def _apply_deep_prompt(
+    h: jnp.ndarray, pr: jnp.ndarray, cache_len: jnp.ndarray
+) -> jnp.ndarray:
+    """Add a learned per-layer deep prompt to ABSOLUTE positions < pre_seq.
+
+    h: [B, T, D] hidden states occupying absolute positions
+    cache_len .. cache_len+T; pr: [pre_seq, D]. The vendored semantics add
+    prompts to the first pre_seq positions of each block's input
+    (``petals/server/backend.py:226-233``, ``block_functions.py:57-65``) —
+    petals slices chunk-relative, which coincides with absolute positions
+    because its inference prompts ride only the position-0 prefill step;
+    absolute indexing generalizes the same contract to chunked prefill and
+    makes decode steps past the prompt region an exact no-op.
+    """
+    t = h.shape[1]
+    pre = pr.shape[0]
+    idx = cache_len + jnp.arange(t, dtype=jnp.int32)          # [T] absolute
+    rows = jnp.take(pr, jnp.clip(idx, 0, pre - 1), axis=0)    # [T, D]
+    add = jnp.where((idx < pre)[:, None], rows, 0).astype(h.dtype)
+    return h + add[None]
+
+
 def stack_forward(
     cfg: ModelConfig,
     layers: Params,
@@ -365,10 +387,14 @@ def stack_forward(
     v_caches: jnp.ndarray,
     cache_len: jnp.ndarray,
     tp_axis: Optional[str] = None,
+    prompts: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Run a span of stacked layers via lax.scan.
 
     layers: pytree with leading layer axis L. k_caches/v_caches: [L,B,S,Hkv,Dh].
+    prompts: optional [L, pre_seq, D] inference-time deep prompts, added
+    into each layer's input at absolute positions < pre_seq
+    (`_apply_deep_prompt`; the petals rpc_forward/inference injection).
 
     Decode steps (T == 1, static under jit) carry the caches through the
     scan and update one layer's rows in place via dynamic indexing instead
@@ -385,7 +411,9 @@ def stack_forward(
 
         def body1(carry, xs):
             h, kc, vc = carry
-            li, lp = xs
+            li, lp = xs[0], xs[1]
+            if prompts is not None:
+                h = _apply_deep_prompt(h, xs[2], cache_len)
             kci = jax.lax.dynamic_index_in_dim(kc, li, 0, keepdims=False)
             vci = jax.lax.dynamic_index_in_dim(vc, li, 0, keepdims=False)
             h, kci, vci = layer_forward(cfg, lp, h, rope, kci, vci,
@@ -394,17 +422,24 @@ def stack_forward(
             vc = jax.lax.dynamic_update_index_in_dim(vc, vci, li, 0)
             return (h, kc, vc), None
 
+        xs = (jnp.arange(L, dtype=jnp.int32), layers)
+        if prompts is not None:
+            xs = xs + (prompts,)
         (x, k_caches, v_caches), _ = jax.lax.scan(
-            body1, (x, k_caches, v_caches),
-            (jnp.arange(L, dtype=jnp.int32), layers))
+            body1, (x, k_caches, v_caches), xs)
         return x, k_caches, v_caches
 
     def body(h, xs):
-        lp, kc, vc = xs
+        lp, kc, vc = xs[0], xs[1], xs[2]
+        if prompts is not None:
+            h = _apply_deep_prompt(h, xs[3], cache_len)
         h, kc, vc = layer_forward(cfg, lp, h, rope, kc, vc, cache_len, tp_axis)
         return h, (kc, vc)
 
-    x, (k_caches, v_caches) = jax.lax.scan(body, x, (layers, k_caches, v_caches))
+    xs = (layers, k_caches, v_caches)
+    if prompts is not None:
+        xs = xs + (prompts,)
+    x, (k_caches, v_caches) = jax.lax.scan(body, x, xs)
     return x, k_caches, v_caches
 
 
@@ -492,13 +527,17 @@ def full_forward(
     k_caches: jnp.ndarray,
     v_caches: jnp.ndarray,
     cache_len: jnp.ndarray,
+    prompts: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Whole unpartitioned model (the single-device oracle path, mirroring
-    reference ``scripts/single_gpu_check.py``). Returns (logits, new caches)."""
+    reference ``scripts/single_gpu_check.py``). Returns (logits, new caches).
+    prompts: optional [num_layers, pre_seq, D] deep prompts (the monolithic
+    oracle for the distributed inference-time injection)."""
     b, t = input_ids.shape
     positions = cache_len + jnp.arange(t, dtype=jnp.int32)[None, :]
     x = embed_tokens(cfg, params["embed"], input_ids, positions)
     x, k_caches, v_caches = stack_forward(
-        cfg, params["layers"], x, positions, k_caches, v_caches, cache_len
+        cfg, params["layers"], x, positions, k_caches, v_caches, cache_len,
+        prompts=prompts,
     )
     return lm_head(cfg, params, x), k_caches, v_caches

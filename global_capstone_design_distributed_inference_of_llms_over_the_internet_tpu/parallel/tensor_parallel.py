@@ -129,6 +129,7 @@ def make_tp_stage_fn(
     mesh: Mesh,
     axis: str = "tp",
     donate_cache: bool = False,
+    with_prompts: bool = False,
 ):
     """Jitted TP stage forward. Caller passes params placed by
     `shard_stage_params` and a KV cache sharded over kv heads
@@ -137,31 +138,33 @@ def make_tp_stage_fn(
     Returns fn(params, x, k, v, cache_len) -> (out, k, v); out replicated.
     `donate_cache=True` donates the k/v buffers (serving: the caller
     threads the returned cache and never reuses the input arrays).
+    `with_prompts=True` appends a replicated deep-prompts argument
+    ([span, pre, D], injected at every block entry — the ptune serving
+    path): fn(params, x, k, v, cache_len, prompts).
     """
     tp = mesh.shape[axis]
     validate_tp(cfg, tp)
     kv_spec = P(None, None, None, axis)
 
     def build(params_example: Params):
-        in_specs = (stage_param_specs(cfg, params_example, axis), P(),
-                    kv_spec, kv_spec, P())
+        param_specs = stage_param_specs(cfg, params_example, axis)
+        in_specs = (param_specs, P(), kv_spec, kv_spec, P())
+        if with_prompts:
+            in_specs = in_specs + (P(),)   # prompts replicated across tp
 
-        @partial(jax.jit,
-                 donate_argnums=(2, 3) if donate_cache else ())
-        @partial(
-            jax.shard_map, mesh=mesh,
-            in_specs=in_specs, out_specs=(P(), kv_spec, kv_spec),
-        )
-        def fn(params, x, k_cache, v_cache, cache_len):
+        def fn(params, x, k_cache, v_cache, cache_len, prompts=None):
             out, k_cache, v_cache = stage_forward(
                 cfg, spec, params, x, k_cache, v_cache, cache_len,
-                tp_axis=axis,
+                tp_axis=axis, prompts=prompts,
             )
             # out is replicated by the closing psums (vma: psum output is
             # axis-invariant), matching out_specs=P().
             return out, k_cache, v_cache
 
-        return fn
+        fn = partial(jax.shard_map, mesh=mesh, in_specs=in_specs,
+                     out_specs=(P(), kv_spec, kv_spec))(fn)
+        return partial(jax.jit,
+                       donate_argnums=(2, 3) if donate_cache else ())(fn)
 
     return build
 

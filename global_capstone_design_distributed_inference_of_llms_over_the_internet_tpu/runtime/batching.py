@@ -506,12 +506,12 @@ class BatchingStageAdapter:
 
         self.requests_served += 1
         if (req.train or req.hypo_ids is not None or req.num_logprobs
-                or req.is_replay
+                or req.is_replay or req.prompts is not None
                 or req.start_from_position not in (None, req.cur_len)):
             raise StageExecutionError(
                 "batched peer serves plain prefill/decode and speculative "
-                "verify only (route beam/training/replay to a per-session "
-                "replica)")
+                "verify only (route beam/training/replay/deep-prompt "
+                "requests to a per-session replica)")
         if req.start_block is not None and (
                 req.start_block != self.spec.start
                 or (req.end_block or self.spec.end) != self.spec.end):

@@ -227,6 +227,10 @@ class PipelineClient:
         # there too or each failover permanently shrinks that server's
         # advertised cache capacity.
         self._session_peers: Dict[str, set] = {}
+        # session -> full deep-prompt tensor [total_blocks, pre, D]; sliced
+        # per hop on every step AND on journal replay (a replacement peer
+        # must rebuild the same prompt-injected hiddens).
+        self._session_prompts: Dict[str, np.ndarray] = {}
         # Route cache per session KIND:
         #   "plain"  — prefers engine=batched peers (one compiled step
         #              serves every concurrent session);
@@ -467,8 +471,29 @@ class PipelineClient:
                 start_block=hop.start_block,
                 end_block=hop.end_block,
                 hypo_ids=None if i == 0 else e.hypo_ids,
+                prompts=self._hop_prompts(session_id, hop, e.cur_len),
             )
             self.transport.call(hop.peer_id, req, timeout=self.request_timeout)
+
+    def _hop_prompts(self, session_id: str, hop: Hop, cur_len: int = 0):
+        return self._span_prompts(session_id, hop.start_block,
+                                  hop.end_block, cur_len)
+
+    def _span_prompts(self, session_id: str, start: int, end: int,
+                      cur_len: int = 0):
+        """One span's slice of the session's deep prompts (rows are absolute
+        block indices — each server gets exactly its span's blocks, the
+        petals client-side prompt split). Returns None once the step sits
+        entirely PAST the prompt region (cur_len >= pre_seq): the injection
+        is an exact no-op there, and dropping the tensor keeps steady-state
+        decode off the wire-heavy classic frame (it re-ships [span, pre, D]
+        floats per hop) and back on the persistent-stream fast path. The
+        slice stays a host numpy view — the transport encodes from host
+        anyway, and the server does its own device put."""
+        pr = self._session_prompts.get(session_id)
+        if pr is None or cur_len >= pr.shape[1] or start >= end:
+            return None
+        return pr[start:end]
 
     def _call_with_recovery(self, hop: Hop, req: StageRequest) -> StageResponse:
         """3-attempt failover (``src/rpc_transport.py:587-668``)."""
@@ -571,7 +596,11 @@ class PipelineClient:
         or its later beam/speculative steps land on a peer that refuses
         them."""
         sampling = sampling or SamplingParams()
-        if self.use_push_chain:
+        # Deep-prompt sessions never push-chain: a relay would need the NEXT
+        # hop's prompt slice, which only the client holds (petals' handler
+        # likewise sets can_push = not has_prompts,
+        # block_functions.py:233).
+        if self.use_push_chain and session_id not in self._session_prompts:
             return self._walk_chain(
                 hidden, seq_len, cur_len, session_id, is_prefill=is_prefill,
                 max_length=max_length, sampling=sampling, generated=generated,
@@ -597,6 +626,7 @@ class PipelineClient:
                 num_logprobs=num_logprobs,
                 draft_tokens=draft_tokens,
                 start_from_position=start_from_position,
+                prompts=self._hop_prompts(session_id, hop, cur_len),
             )
             t0 = time.monotonic()
             resp = self._call_with_recovery(hop, req)
@@ -796,8 +826,16 @@ class PipelineClient:
         max_length: Optional[int] = None,
         speculative_k: int = 0,
         draft_fn=None,
+        deep_prompts=None,
     ) -> GenerationResult:
-        """``speculative_k > 0`` enables speculative decoding: per decode
+        """``deep_prompts`` ([total_blocks, pre_seq, D]) enables
+        inference-time deep prompt tuning: each step, every server injects
+        its span's learned prompts at each block's entry (absolute
+        positions < pre_seq), matching a monolithic forward with the same
+        prompts (``petals/server/block_functions.py:57-65,171-226``). The
+        session routes kind="exotic" — batched/sp engines refuse prompts.
+
+        ``speculative_k > 0`` enables speculative decoding: per decode
         round the client drafts up to K tokens (``draft_fn(context, k)``,
         default n-gram prompt lookup — runtime.speculative), ships them as
         one multi-token step, and the final stage verifies — amortizing the
@@ -807,15 +845,44 @@ class PipelineClient:
         uses rejection-sampling verification (accept draft i with prob
         p_i(d_i), resample the residual on reject), which preserves the
         sampling distribution exactly."""
-        sampling = sampling or SamplingParams()
         session_id = session_id or f"sess-{time.monotonic_ns():x}"
+        if deep_prompts is not None:
+            self._session_prompts[session_id] = np.asarray(deep_prompts)
+        try:
+            return self._generate_impl(
+                prompt_ids, max_new_tokens, sampling=sampling,
+                eos_token_id=eos_token_id, session_id=session_id,
+                max_length=max_length, speculative_k=speculative_k,
+                draft_fn=draft_fn)
+        finally:
+            # Error paths included: a failed session must not leak its
+            # deep-prompt tensor, KV leases, or journal entries.
+            self._end_session(session_id)
+
+    def _generate_impl(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int,
+        *,
+        sampling: Optional[SamplingParams],
+        eos_token_id: Optional[int],
+        session_id: str,
+        max_length: Optional[int],
+        speculative_k: int,
+        draft_fn,
+    ) -> GenerationResult:
+        sampling = sampling or SamplingParams()
         prompt_len = len(prompt_ids)
+        dp = self._session_prompts.get(session_id)
+        s0 = self.stage0.spec
         # Session kind is fixed at entry: a speculative session's PREFILL
         # must already land on a peer that will take its draft steps
         # (batched peers verify drafts in coalesced rounds; sp peers refuse
         # them); a plain session prefers batched peers; a long-context
         # session prefers sp peers (prefix KV sharded across their mesh).
-        if speculative_k > 0:
+        if dp is not None:
+            kind = "exotic"  # single-session engines refuse deep prompts
+        elif speculative_k > 0:
             kind = "spec"
         elif (self.long_context_threshold is not None
               and prompt_len >= self.long_context_threshold):
@@ -835,6 +902,7 @@ class PipelineClient:
         s0_resp = self.stage0.forward(StageRequest(
             session_id=session_id, hidden=ids, seq_len=prompt_len, cur_len=0,
             is_prefill=True, max_length=max_length, sampling=sampling,
+            prompts=self._span_prompts(session_id, s0.start, s0.end, 0),
         ))
         times: Dict[str, float] = {}
         resp = self._walk(
@@ -879,6 +947,8 @@ class PipelineClient:
                 session_id=session_id, hidden=step_ids, seq_len=t_in,
                 cur_len=cur_len, is_prefill=False, max_length=max_length,
                 sampling=sampling, start_from_position=spos,
+                prompts=self._span_prompts(session_id, s0.start, s0.end,
+                                           cur_len),
             ))
             times: Dict[str, float] = {}
             resp = self._walk(
@@ -919,7 +989,6 @@ class PipelineClient:
                 stopped_by = stop
                 break
 
-        self._end_session(session_id)
         return GenerationResult(
             tokens=generated, ttft_s=ttft, decode_times_s=decode_times,
             stopped_by=stopped_by,
@@ -1067,6 +1136,7 @@ class PipelineClient:
 
     def _end_session(self, session_id: str) -> None:
         self.stage0.drop_session(session_id)
+        self._session_prompts.pop(session_id, None)
         # Release the KV lease on every peer that ever held it (best-effort):
         # current route hops PLUS peers abandoned by failover — without this,
         # each generation (or failover) permanently consumes arena budget.

@@ -255,6 +255,9 @@ class StageExecutor:
         # input-shape pair — the bucket padding below bounds how many shapes
         # it ever sees.
         self._subspans: Dict[tuple, tuple] = {}
+        # (a, b) -> prompt-injecting step callable (deep-prompt requests
+        # only; kept separate so every _subspans entry stays a 3-tuple).
+        self._prompt_steps: Dict[tuple, Any] = {}
         self._get_subspan(0, spec.num_layers)
 
     def _get_subspan(self, a: int, b: int):
@@ -312,6 +315,39 @@ class StageExecutor:
         entry = (sub_spec, sub_params, step)
         self._subspans[key] = entry
         return entry
+
+    def _get_prompt_step(self, a: int, b: int):
+        """Step for inference requests carrying DEEP PROMPTS
+        (``petals/server/block_functions.py:57-65,171-226``): same math as
+        the plain subspan step plus a per-layer prompt injection at each
+        block's entry, on EVERY engine (plain jit, offload, tp). Cached
+        separately — the plain hot path keeps its prompt-free signature
+        (and donation) untouched; jit re-specializes per prompts shape."""
+        key = (a, b)
+        entry = self._prompt_steps.get(key)
+        if entry is not None:
+            return entry
+        sub_spec, sub_params, plain_step = self._get_subspan(a, b)
+        cfg = self.cfg
+
+        if self.offload:
+            # OffloadedSpanRunner takes prompts as a trailing optional arg.
+            step = plain_step
+        elif self.tp_mesh is not None:
+            from ..parallel.tensor_parallel import make_tp_stage_fn
+
+            step = make_tp_stage_fn(
+                cfg, sub_spec, self.tp_mesh, self.tp_axis,
+                donate_cache=bool(engine_donation(0)), with_prompts=True,
+            )(sub_params)
+        else:
+            @partial(jax.jit, donate_argnums=engine_donation(2, 3))
+            def step(params, x, k_cache, v_cache, cache_len, prompts):
+                return stage_forward(cfg, sub_spec, params, x, k_cache,
+                                     v_cache, cache_len, prompts=prompts)
+
+        self._prompt_steps[key] = step
+        return step
 
     def _resolve_range(self, req: StageRequest) -> tuple:
         """Absolute request block range -> relative (a, b) within the span."""
@@ -380,6 +416,19 @@ class StageExecutor:
         """Run one step of this stage for one session."""
         a, b = self._resolve_range(req)
         sub_spec, sub_params, step = self._get_subspan(a, b)
+
+        prompts = None
+        if req.prompts is not None:
+            # Inference-time deep prompt tuning (petals
+            # block_functions.py:171-226): inject the client's learned
+            # per-block prompts at every block entry, every step.
+            prompts = jnp.asarray(req.prompts)
+            if prompts.ndim != 3 or prompts.shape[0] != b - a:
+                raise StageExecutionError(
+                    f"prompts shape {tuple(prompts.shape)} does not cover "
+                    f"the requested {b - a} blocks (want [span, pre, D])"
+                )
+            step = self._get_prompt_step(a, b)
 
         x = jnp.asarray(req.hidden)
         # stage0 consumes int token ids [B, T]; later stages float hidden
@@ -459,7 +508,8 @@ class StageExecutor:
         while off < t_real:
             n = min(chunk, t_real - off)
             xc = jax.lax.slice_in_dim(x, off, off + n, axis=1)
-            outs.append(self._dispatch_chunk(step, sub_params, xc, handle, n))
+            outs.append(self._dispatch_chunk(step, sub_params, xc, handle, n,
+                                             prompts=prompts))
             off += n
         self.requests_served += 1
 
@@ -516,9 +566,14 @@ class StageExecutor:
         return max(b for b in SEQ_BUCKETS if b <= est)
 
     def _dispatch_chunk(self, step, sub_params, x: jnp.ndarray,
-                        handle: KVHandle, n: int) -> jnp.ndarray:
+                        handle: KVHandle, n: int,
+                        prompts: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         """Run ONE bucket-padded jitted step of n real tokens against the
-        session cache; advances the cache and returns the TRIMMED output."""
+        session cache; advances the cache and returns the TRIMMED output.
+        Bucket-padded tail positions may receive a deep-prompt injection
+        too (absolute index < pre_seq); harmless — their output rows are
+        trimmed here and their KV rows sit past cache_len until a real
+        token overwrites them."""
         tb = round_to_bucket(n, SEQ_BUCKETS)
         if handle.cache_len + tb > handle.bucket_len:
             # Padding would make the jitted dynamic_update_slice clamp its
@@ -530,9 +585,14 @@ class StageExecutor:
             pad = ((0, 0), (0, tb - n)) + (((0, 0),) if x.ndim == 3 else ())
             x = jnp.pad(x, pad)
         cache_len = jnp.asarray(handle.cache_len, jnp.int32)
-        out, handle.k, handle.v = step(
-            sub_params, x, handle.k, handle.v, cache_len
-        )
+        if prompts is None:
+            out, handle.k, handle.v = step(
+                sub_params, x, handle.k, handle.v, cache_len
+            )
+        else:
+            out, handle.k, handle.v = step(
+                sub_params, x, handle.k, handle.v, cache_len, prompts
+            )
         handle.advance(n)
         return out[:, :n]
 

@@ -52,7 +52,12 @@ class StageRequest:
     # forward of the BLOCKS only (no head/sampling), with optional deep
     # prompts added into the first positions of each block's input.
     train: bool = False
-    prompts: Optional[jnp.ndarray] = None   # [span_layers, pre_seq, D]
+    # Deep prompts, [span_layers, pre_seq, D]. train=True: the rpc_forward
+    # training injection above. train=False: INFERENCE-time deep prompt
+    # tuning (``petals/server/block_functions.py:171-226``) — every step,
+    # each block of the span adds its prompt at absolute positions <
+    # pre_seq before computing (executor._get_prompt_step).
+    prompts: Optional[jnp.ndarray] = None
     # Session rewind (the ``start_from_position`` of petals
     # ``handler.py:163-168`` / ``block_functions.py:163-168``): before this
     # step, shrink the session's valid KV prefix to this position — the

@@ -230,7 +230,8 @@ def _decode_tensors(metas: list, payload: bytes) -> list:
 
 
 def _request_header(req: StageRequest, tensor_meta: dict,
-                    model: Optional[str] = None) -> dict:
+                    model: Optional[str] = None,
+                    prompts_meta: Optional[dict] = None) -> dict:
     hdr = {
         "verb": "forward",
         "session_id": req.session_id,
@@ -260,11 +261,20 @@ def _request_header(req: StageRequest, tensor_meta: dict,
     # (wrong model's server) must fail loudly, not produce garbage activations.
     if model is not None:
         hdr["model"] = model
+    # Inference-time deep prompts ride as a second payload tensor (the
+    # petals handler's optional prompts input, block_functions.py:171-226).
+    if prompts_meta is not None:
+        hdr["prompts_tensor"] = prompts_meta
     return hdr
 
 
 def _header_to_request(h: dict, payload: bytes) -> StageRequest:
-    arr = _decode_tensor(h["tensor"], payload)
+    pr = None
+    if h.get("prompts_tensor") is not None:
+        arr, pr = _decode_tensors([h["tensor"], h["prompts_tensor"]], payload)
+        pr = jnp.asarray(pr)
+    else:
+        arr = _decode_tensor(h["tensor"], payload)
     return StageRequest(
         session_id=h["session_id"],
         hidden=jnp.asarray(arr),
@@ -289,6 +299,7 @@ def _header_to_request(h: dict, payload: bytes) -> StageRequest:
         draft_tokens=(None if h.get("draft_tokens") is None
                       else tuple(h["draft_tokens"])),
         model=h.get("model"),
+        prompts=pr,
     )
 
 
@@ -1072,7 +1083,8 @@ class TcpTransport(Transport):
         full-metadata frame."""
         return (self.use_streams and not request.train
                 and request.hypo_ids is None and request.num_logprobs == 0
-                and request.draft_tokens is None and not request.is_replay)
+                and request.draft_tokens is None and not request.is_replay
+                and request.prompts is None)
 
     def call(self, peer_id: str, request: StageRequest,
              timeout: Optional[float] = None) -> StageResponse:
@@ -1094,6 +1106,17 @@ class TcpTransport(Transport):
                     "end_block": request.end_block,
                     "tensors": metas,
                 }
+                _send_frame(sock, self._tagged(hdr), body)
+            elif request.prompts is not None:
+                # Deep-prompt inference step: prompts ride as a second
+                # payload tensor (classic frame — never streamed/pushed,
+                # matching petals' can_push = not has_prompts).
+                metas, body = _encode_tensors(
+                    [np.asarray(request.hidden), np.asarray(request.prompts)],
+                    self.wire_dtype)
+                hdr = _request_header(request, metas[0],
+                                      prompts_meta=metas[1])
+                hdr["wire_dtype"] = self.wire_dtype
                 _send_frame(sock, self._tagged(hdr), body)
             else:
                 arr = np.asarray(request.hidden)

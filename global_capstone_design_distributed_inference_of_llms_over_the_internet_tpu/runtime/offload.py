@@ -32,6 +32,7 @@ from ..utils.platform import engine_donation
 from ..models.config import ModelConfig
 from ..models.partition import StageSpec
 from ..models.transformer import (
+    _apply_deep_prompt,
     embed_tokens,
     layer_forward,
     lm_head,
@@ -123,7 +124,12 @@ class OffloadedSpanRunner:
         return jax.tree.map(lambda a: jax.device_put(a, self.device),
                             self.host_layers[i])
 
-    def __call__(self, _params_ignored, x, k_all, v_all, cache_len):
+    def __call__(self, _params_ignored, x, k_all, v_all, cache_len,
+                 prompts=None):
+        """``prompts`` ([span, pre, D]) enables inference-time deep prompt
+        injection per streamed layer (eager jnp add before each layer's
+        jitted step — this engine is transfer-bound, the extra dispatch is
+        noise)."""
         x = jnp.asarray(x)
         cache_len = jnp.asarray(cache_len, jnp.int32)
         x, rope = self._enter(x, cache_len, self.spec.is_first)
@@ -132,6 +138,8 @@ class OffloadedSpanRunner:
         if self.resident is not None:
             for r in range(self.keep_resident):
                 lp = jax.tree.map(lambda a, r=r: a[r], self.resident)
+                if prompts is not None:
+                    x = _apply_deep_prompt(x, prompts[li], cache_len)
                 x, k_all, v_all = self._layer(lp, x, rope, k_all, v_all,
                                               jnp.int32(li), cache_len)
                 li += 1
@@ -143,6 +151,8 @@ class OffloadedSpanRunner:
                 # issue the next copy BEFORE dispatching this layer's
                 # compute: async dispatch overlaps transfer with compute
                 pending = self._fetch(i + 1)
+            if prompts is not None:
+                x = _apply_deep_prompt(x, prompts[li], cache_len)
             x, k_all, v_all = self._layer(lp, x, rope, k_all, v_all,
                                           jnp.int32(li), cache_len)
             li += 1

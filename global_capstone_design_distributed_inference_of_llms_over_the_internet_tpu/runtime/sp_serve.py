@@ -131,10 +131,12 @@ class SpStageAdapter:
         self.requests_served += 1
         if (req.train or req.hypo_ids is not None or req.num_logprobs
                 or req.draft_tokens is not None or req.is_replay
+                or req.prompts is not None
                 or req.start_from_position not in (None, req.cur_len)):
             raise StageExecutionError(
-                "sp peer serves plain prefill/decode only "
-                "(route beam/speculative/replay to a per-session replica)")
+                "sp peer serves plain prefill/decode only (route beam/"
+                "speculative/replay/deep-prompt requests to a per-session "
+                "replica)")
         if req.start_block is not None and (
                 req.start_block != self.spec.start
                 or (req.end_block or self.spec.end) != self.spec.end):
