@@ -524,6 +524,145 @@ def bench_ring_decode(num_stages=4, num_groups=4, slot_b=2, prefill=32,
     }
 
 
+def bench_ring_speculative(num_stages=4, num_groups=4, k_draft=3,
+                           prefill=32, n_tokens=24, max_len=128, reps=2):
+    """Ring x speculative decoding (VERDICT r4 weak item 3): each round
+    every session consumes 1 + K positions (last token + K drafts) and the
+    last stage verifies in-program, so one pipeline traversal of
+    G + S - 1 ticks yields up to G*(K+1) tokens.
+
+    Structural row on the virtual CPU mesh: the schedule's win is
+    TICKS/TOKEN — plain ring decode pays 1 tick per token (steady state);
+    at acceptance rate a the spec round pays (G+S-1)/(G*(1+a*K)). On the
+    serialized host backend wall time tracks total COMPUTE (each tick does
+    (K+1)x the work), so wall here prices the compute overhead while the
+    tick arithmetic prices the latency win a real deployment sees (each
+    tick's wall on hardware is bounded by the span forward, and rounds
+    amortize the per-round dispatch). Both are reported. Token parity with
+    the plain ring is pinned by tests/test_ring_decode.py and the ring-CLI
+    spec test."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.parallel.pipeline import (
+        IciPipeline,
+        make_pipeline_mesh,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.parallel.ring_decode import (
+        RingDecoder,
+        make_ring_spec_round,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.ops.sampling import (
+        RECENT_WINDOW,
+    )
+
+    S, G, K = num_stages, num_groups, k_draft
+    cfg = get_config("gpt2")
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.bfloat16)
+    mesh = make_pipeline_mesh(S)
+    pipe = IciPipeline.build(cfg, params, num_stages=S, num_micro=G,
+                             mesh=mesh)
+    rd = RingDecoder.build(pipe, max_steps=n_tokens, exact_head=False)
+    round_fn = make_ring_spec_round(pipe, K)
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (G, 1, prefill)),
+                      jnp.int32)
+    k, v = pipe.init_kv(1, max_len, dtype=jnp.bfloat16)
+    logits, k, v = pipe.forward(ids, k, v, jnp.int32(0))
+    tok0 = jnp.argmax(
+        logits[:, :, -1].astype(jnp.float32), -1).astype(jnp.int32)
+    lens = jnp.full((G,), prefill, jnp.int32)
+
+    # Plain-ring reference run (also produces the ground-truth tokens that
+    # serve as PERFECT drafts for the accept-all measurement).
+    kp, vp = jax.tree.map(jnp.copy, (k, v))
+    rd.decode(tok0, *jax.tree.map(jnp.copy, (kp, vp)), lens, n_tokens)  # warm
+    t0 = time.perf_counter()
+    ref_toks, _, _ = rd.decode(tok0, kp, vp, lens, n_tokens)
+    ref = np.asarray(ref_toks)
+    t_plain = time.perf_counter() - t0
+
+    kw = dict(temps=jnp.zeros((G,), jnp.float32),
+              top_ps=jnp.full((G,), 0.9, jnp.float32),
+              top_ks=jnp.full((G,), 20, jnp.int32),
+              reps=jnp.full((G,), 1.0, jnp.float32))
+    recent0 = jnp.zeros((G, 1, RECENT_WINDOW), jnp.int32)
+    nvalid0 = jnp.zeros((G, 1), jnp.int32)
+
+    def run_rounds(perfect: bool):
+        """Decode n_tokens per session via spec rounds; returns (wall,
+        rounds, accepted_drafts, tokens)."""
+        kk, vv = jax.tree.map(jnp.copy, (k, v))
+        sessions = [[int(tok0[g, 0])] for g in range(G)]
+        lens_np = np.full((G,), prefill, np.int32)
+        recent, nvalid = recent0, nvalid0
+        rounds = accepted = 0
+        t0 = time.perf_counter()
+        while any(len(s) < n_tokens for s in sessions):
+            tokens_in = np.zeros((G, 1, K + 1), np.int32)
+            for g in range(G):
+                got = len(sessions[g])
+                tokens_in[g, 0, 0] = sessions[g][-1]
+                if perfect:
+                    fut = ref[got - 1: got - 1 + K, g, 0]
+                    tokens_in[g, 0, 1:1 + len(fut)] = fut
+                else:
+                    tokens_in[g, 0, 1:] = ((tokens_in[g, 0, 0] + 1)
+                                           % cfg.vocab_size)
+            toks, nacc, kk, vv, recent, nvalid = round_fn(
+                tokens_in, kk, vv, lens_np,
+                seed_base=np.full((G,), 7, np.int32),
+                recent=recent, nvalid=nvalid, **kw)
+            toks, nacc = np.asarray(toks), np.asarray(nacc)
+            rounds += 1
+            for g in range(G):
+                if len(sessions[g]) >= n_tokens:
+                    continue
+                na = int(nacc[g, 0])
+                accepted += na
+                sessions[g].extend(int(x) for x in toks[g, 0, : na + 1])
+                lens_np[g] += na + 1
+        wall = time.perf_counter() - t0
+        return wall, rounds, accepted, sessions
+
+    run_rounds(True)  # compile, unclocked
+    best = None
+    for _ in range(reps):
+        wall, rounds, accepted, sessions = run_rounds(True)
+        if best is None or wall < best[0]:
+            best = (wall, rounds, accepted, sessions)
+    wall_p, rounds_p, acc_p, sessions_p = best
+    wall_g, rounds_g, acc_g, _ = run_rounds(False)
+
+    # Parity: perfect-draft spec decode must reproduce the plain-ring run.
+    for g in range(G):
+        got = sessions_p[g][:n_tokens]
+        want = [int(tok0[g, 0])] + ref[: n_tokens - 1, g, 0].tolist()
+        assert got == want, f"spec decode diverged from plain ring at g={g}"
+
+    toks_total = G * (n_tokens - 1)
+    accept_rate_p = acc_p / (rounds_p * G * K)
+    ticks = lambda r: r * (G + S - 1)
+    return {
+        "num_stages": S, "session_groups": G, "k_draft": K, "model": "gpt2",
+        "plain_ring_ticks_per_token": round(
+            (G * n_tokens + S - 1) / (G * n_tokens), 3),
+        "spec_rounds_full_accept": rounds_p,
+        "spec_ticks_per_token_full_accept": round(
+            ticks(rounds_p) / toks_total, 3),
+        "spec_ticks_per_token_zero_accept": round(
+            ticks(rounds_g) / toks_total, 3),
+        "accept_rate_measured_full": round(accept_rate_p, 3),
+        "round_ms": round(wall_p / rounds_p * 1e3, 2),
+        "plain_chunk_ms": round(t_plain * 1e3, 2),
+        "backend": jax.devices()[0].platform,
+        "note": ("virtual-mesh structural row: serialized-backend wall "
+                 "prices total compute ((K+1)x per tick), so the latency "
+                 "win shows in TICKS/TOKEN — full acceptance cuts it from "
+                 "~1 to (G+S-1)/(G*(K+1)); real acceptance interpolates. "
+                 "Greedy output is draft-independent (parity asserted "
+                 "in-row and in tests)"),
+    }
+
+
 def bench_ring_causal_skip(p=8, b=1, h=8, hkv=4, dh=64, c=512, reps=3):
     """Causal-skip ring attention (VERDICT r3 item 4): devices skip the
     score/value compute for KV blocks wholly in their future (lax.cond),
@@ -743,6 +882,15 @@ def main():
         print(json.dumps(bench_ring_decode()))
         return
 
+    if "--ring-spec-row" in sys.argv:
+        from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.utils.platform import (
+            force_cpu_devices,
+        )
+
+        force_cpu_devices(4, hard=True)
+        print(json.dumps(bench_ring_speculative()))
+        return
+
     if "--sp-row" in sys.argv:
         from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.utils.platform import (
             force_cpu_devices,
@@ -883,6 +1031,9 @@ def main():
     # VERDICT r3 item 1: multi-session ring decode fills the decode bubble.
     results["pipeline_decode_multisession"] = _run_pipeline_row_subprocess(
         "--ring-row")
+    # VERDICT r4 weak item 3: ring x speculative composition ticks/token.
+    results["ring_speculative"] = _run_pipeline_row_subprocess(
+        "--ring-spec-row")
     # VERDICT r3 item 4: causal-skip ring attention work ratio.
     results["sp_prefill_causal_skip"] = _run_pipeline_row_subprocess(
         "--sp-row")

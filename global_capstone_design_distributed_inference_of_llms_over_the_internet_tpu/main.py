@@ -420,7 +420,14 @@ def _run_fused_ring(args, cfg: ModelConfig, params, num_stages: int) -> int:
     bubble-free rotation schedule. Each session prefills its own length
     via the masked single-group prefill, then all G decode together — one
     sampled token per tick in steady state instead of one per S ticks.
-    Greedy (the fused sampler contract matches --mode fused)."""
+    temperature > 0 runs the FULL reference sampler inside the rotation
+    (per-session recent windows, the oracle's PRNGKey(seed + i) schedule —
+    each session's text matches --mode oracle for its prompt); greedy
+    otherwise. --speculative_k composes with both: greedy output stays
+    token-identical to the plain ring for any draft quality; sampled +
+    speculative preserves the sampling DISTRIBUTION exactly (rejection
+    sampling) but uses a per-round key schedule, so the text differs from
+    the non-speculative run at the same seed (logged below)."""
     from .parallel.pipeline import IciPipeline
     from .parallel.ring_decode import RingDecoder, make_ring_prefill_group
 
@@ -441,27 +448,60 @@ def _run_fused_ring(args, cfg: ModelConfig, params, num_stages: int) -> int:
     prompt_ids = [[i % cfg.vocab_size for i in tokenizer.encode(p)]
                   for p in prompts]
     eos = getattr(tokenizer, "eos_token_id", None)
-    if args.temperature > 0:
-        logger.warning("ring decode samples greedily (temperature ignored)")
+    sampled = args.temperature > 0
 
+    spec_k = getattr(args, "speculative_k", 0) or 0
+    if spec_k and sampled:
+        logger.warning(
+            "sampled + speculative ring: rejection-sampling verification "
+            "preserves the sampling distribution exactly, but the per-round "
+            "key schedule differs from the per-token one — text will not "
+            "bitwise-match the same seed without --speculative_k")
     pipe = IciPipeline.build(cfg, params, num_stages=num_stages,
                              num_micro=G, tp=args.tp)
-    logger.info("ring decode: %d sessions over %d stages x tp=%d",
-                G, num_stages, args.tp)
+    logger.info("ring decode: %d sessions over %d stages x tp=%d (%s%s)",
+                G, num_stages, args.tp,
+                "sampled" if sampled else "greedy",
+                f", speculative_k={spec_k}" if spec_k else "")
     chunk = 16
-    rd = RingDecoder.build(pipe, max_steps=chunk)
-    prefill_one = make_ring_prefill_group(pipe)
-    # chunk-1 of overshoot headroom: a session finishing mid-chunk still
-    # has its (discarded) extra steps' KV writes land in-bounds.
-    max_len = max(len(p) for p in prompt_ids) + args.max_new_tokens + chunk
+    if spec_k:
+        from .parallel.ring_decode import make_ring_spec_round
+
+        round_fn = make_ring_spec_round(pipe, spec_k)
+    else:
+        rd = RingDecoder.build(pipe, max_steps=chunk, sampled=sampled)
+    prefill_one = make_ring_prefill_group(pipe, return_logits=sampled)
+    # chunk-1 (or one spec round) of overshoot headroom: a session finishing
+    # mid-chunk still has its (discarded) extra steps' KV writes in-bounds.
+    max_len = (max(len(p) for p in prompt_ids) + args.max_new_tokens
+               + max(chunk, spec_k + 1))
     k, v = pipe.init_kv(1, max(128, max_len), dtype=pipe.embed["wte"].dtype)
+
+    from .ops.sampling import RECENT_WINDOW, push_recent, sample_token
+
+    sp_scalars = (jnp.asarray(args.temperature, jnp.float32),
+                  jnp.asarray(args.top_p, jnp.float32),
+                  jnp.asarray(args.top_k, jnp.int32),
+                  jnp.asarray(args.repetition_penalty, jnp.float32))
+    recent = jnp.zeros((G, 1, RECENT_WINDOW), jnp.int32)
+    nvalid = jnp.zeros((G, 1), jnp.int32)
 
     t0 = time.monotonic()
     lens = np.zeros((G,), np.int32)
     tok0 = np.zeros((G, 1), np.int32)
     for g, ids_g in enumerate(prompt_ids):
         first, k, v = prefill_one(jnp.asarray([ids_g], jnp.int32), k, v, g)
-        tok0[g] = np.asarray(first)
+        if sampled:
+            # Key-schedule step 0 on the prefill logits (run_oracle parity).
+            tok = sample_token(jax.random.PRNGKey(args.seed),
+                               first[0], recent[g, 0], nvalid[g, 0],
+                               *sp_scalars)
+            r2, n2 = push_recent(recent[g, 0], nvalid[g, 0], tok)
+            recent = recent.at[g, 0].set(r2)
+            nvalid = nvalid.at[g, 0].set(n2)
+            tok0[g] = int(tok)
+        else:
+            tok0[g] = np.asarray(first)
         lens[g] = len(ids_g)
     ttft = time.monotonic() - t0
 
@@ -469,34 +509,96 @@ def _run_fused_ring(args, cfg: ModelConfig, params, num_stages: int) -> int:
     done = [False] * G
     cur_tok = jnp.asarray(tok0)
     lens_j = jnp.asarray(lens)
+    sp_vecs = dict(
+        temps=jnp.full((G,), args.temperature, jnp.float32),
+        top_ps=jnp.full((G,), args.top_p, jnp.float32),
+        top_ks=jnp.full((G,), args.top_k, jnp.int32),
+        reps=jnp.full((G,), args.repetition_penalty, jnp.float32))
+    steps_done = 1      # PRNG schedule index: prefill token was step 0
     t0 = time.monotonic()
     # Count only tokens harvested INSIDE the decode loop: the first token
     # per session came from prefill (its cost sits in TTFT, not here).
     produced = 0
-    while True:
-        act = [g for g in range(G)
-               if not done[g] and len(sessions[g]) < args.max_new_tokens]
-        if not act:
-            break
-        n = max(1, min(chunk, max(args.max_new_tokens - len(sessions[g])
-                                  for g in act)))
-        toks, k, v = rd.decode(cur_tok, k, v, lens_j, n)
-        toks = np.asarray(toks[:n])
-        for g in range(G):
-            for i in range(n):
-                if done[g] or len(sessions[g]) >= args.max_new_tokens:
-                    done[g] = True
-                    break
-                t = int(toks[i, g, 0])
-                sessions[g].append(t)
-                produced += 1
-                if eos is not None and t == eos:
-                    done[g] = True
-                elif (len(sessions[g]) >= 5
-                      and len(set(sessions[g][-5:])) == 1):
-                    done[g] = True
-        cur_tok = jnp.asarray(toks[n - 1])
-        lens_j = lens_j + n
+    rounds = accepted = 0
+
+    def _harvest(g, run) -> None:
+        """Append tokens to session g with per-token stop checks."""
+        nonlocal produced
+        for t in run:
+            if done[g] or len(sessions[g]) >= args.max_new_tokens:
+                done[g] = True
+                return
+            t = int(t)
+            sessions[g].append(t)
+            produced += 1
+            if eos is not None and t == eos:
+                done[g] = True
+            elif (len(sessions[g]) >= 5
+                  and len(set(sessions[g][-5:])) == 1):
+                done[g] = True
+
+    if spec_k:
+        # Ring x speculative: each round every session consumes its last
+        # token + K client-drafted tokens; the last stage verifies
+        # in-program (greedy chain or rejection sampling), yielding 1..K+1
+        # tokens per session per pipeline traversal. Greedy output is
+        # token-identical to the plain ring regardless of draft quality.
+        from .runtime.speculative import ngram_draft
+
+        contexts = [list(prompt_ids[g]) + list(sessions[g])
+                    for g in range(G)]
+        lens_np = lens.copy()
+        while True:
+            act = [g for g in range(G)
+                   if not done[g] and len(sessions[g]) < args.max_new_tokens]
+            if not act:
+                break
+            tokens_in = np.zeros((G, 1, spec_k + 1), np.int32)
+            for g in range(G):
+                tokens_in[g, 0, 0] = sessions[g][-1]
+                drafts = (list(ngram_draft(contexts[g], spec_k))
+                          if not done[g] else [])
+                for i in range(spec_k):   # short draft runs pad with 0 — a
+                    # pad is just a (probably wrong) draft; verification
+                    # keeps the output exact either way.
+                    tokens_in[g, 0, 1 + i] = (drafts[i] if i < len(drafts)
+                                              else 0)
+            seed_base = np.asarray(
+                [args.seed + len(sessions[g]) for g in range(G)], np.int32)
+            toks, nacc, k, v, recent, nvalid = round_fn(
+                tokens_in, k, v, lens_np, seed_base=seed_base,
+                recent=recent, nvalid=nvalid, **sp_vecs)
+            toks, nacc = np.asarray(toks), np.asarray(nacc)
+            rounds += 1
+            for g in act:
+                na = int(nacc[g, 0])
+                accepted += na
+                run = toks[g, 0, : na + 1].tolist()
+                lens_np[g] += na + 1
+                _harvest(g, run)
+                contexts[g] = list(prompt_ids[g]) + list(sessions[g])
+    else:
+        while True:
+            act = [g for g in range(G)
+                   if not done[g] and len(sessions[g]) < args.max_new_tokens]
+            if not act:
+                break
+            n = max(1, min(chunk, max(args.max_new_tokens - len(sessions[g])
+                                      for g in act)))
+            if sampled:
+                toks, k, v, recent, nvalid = rd.decode_sampled(
+                    cur_tok, k, v, lens_j, n,
+                    seed_base=jnp.full((G,), args.seed + steps_done,
+                                       jnp.int32),
+                    recent=recent, nvalid=nvalid, **sp_vecs)
+            else:
+                toks, k, v = rd.decode(cur_tok, k, v, lens_j, n)
+            steps_done += n
+            toks = np.asarray(toks[:n])
+            for g in range(G):
+                _harvest(g, toks[:, g, 0])
+            cur_tok = jnp.asarray(toks[n - 1])
+            lens_j = lens_j + n
     decode_s = time.monotonic() - t0
 
     for g, toks_g in enumerate(sessions):
@@ -508,6 +610,10 @@ def _run_fused_ring(args, cfg: ModelConfig, params, num_stages: int) -> int:
     print(f"Decode: {decode_s:.3f}s total, {rate:.2f} tokens/s aggregate "
           f"across {G} sessions (decode-loop tokens only; each session's "
           f"first token comes from prefill)")
+    if spec_k and rounds:
+        print(f"Speculative: {rounds} rounds, "
+              f"{accepted / (rounds * len(sessions)):.2f} drafts accepted "
+              f"per session-round (of {spec_k})")
     return 0
 
 

@@ -208,6 +208,77 @@ def speculative_verify(
     return tokens, k
 
 
+def speculative_verify_jit(
+    key: jax.Array,
+    logits: jnp.ndarray,        # [K+1, V] fp32
+    drafts: jnp.ndarray,        # [K] int32
+    recent_tokens: jnp.ndarray,
+    num_valid: jnp.ndarray,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    top_k: jnp.ndarray,
+    repetition_penalty: jnp.ndarray,
+):
+    """Fully-traceable speculative verification (the in-jit counterpart of
+    `speculative_verify`, for engines that verify INSIDE a compiled
+    program — parallel.ring_decode's spec round).
+
+    Greedy (temperature <= 0): accept while draft[i] == argmax(logits[i])
+    (unpenalized, matching ``executor.verify_drafts_from_logits`` — the
+    reference applies greedy before penalties, src/rpc_handler.py:334-335);
+    correction/bonus = the argmax. Sampled: deterministic-proposal
+    rejection sampling — accept draft i with probability p_i(draft_i)
+    under the full penalized/filtered target, correction from the residual
+    with the draft zeroed, bonus from p_K — preserving the sampling law
+    exactly (same argument as `speculative_verify`). The recent window
+    evolves WITH each accepted token, so every position's target equals
+    what non-speculative decoding would have used.
+
+    Returns (tokens [K+1] int32 — positions > n_accepted are zero —,
+    n_accepted, new recent, new num_valid). len of the real run is
+    n_accepted + 1 (accepted prefix + correction/bonus)."""
+    k = drafts.shape[0]
+    greedy_mode = temperature <= 0.0
+    knobs = (temperature, top_p, top_k, repetition_penalty)
+
+    def body(i, carry):
+        stopped, n_acc, recent, nvalid, toks, key = carry
+        key, ku, kr = jax.random.split(key, 3)
+        probs = sample_probs(logits[i], recent, nvalid, *knobs)
+        am = jnp.argmax(logits[i], axis=-1).astype(jnp.int32)
+        is_bonus = i >= k             # position K: no draft to check
+        d = drafts[jnp.clip(i, 0, k - 1)]
+        accept_s = jax.random.uniform(ku) < probs[d]
+        accept = jnp.where(greedy_mode, d == am, accept_s) & ~is_bonus
+        # Correction (reject) / bonus (i == K) token.
+        residual = probs.at[d].set(jnp.where(is_bonus, probs[d], 0.0))
+        z = residual.sum()
+        residual = jnp.where(z > 0, residual / jnp.maximum(z, 1e-20), probs)
+        corr_s = jax.random.categorical(
+            kr, jnp.log(jnp.maximum(residual, 1e-20))).astype(jnp.int32)
+        tok = jnp.where(accept, d, jnp.where(greedy_mode, am, corr_s))
+        write = ~stopped
+        toks = jnp.where(write, toks.at[i].set(tok), toks)
+        r2, n2 = push_recent(recent, nvalid, tok)
+        recent = jnp.where(write, r2, recent)
+        nvalid = jnp.where(write, n2, nvalid)
+        n_acc = n_acc + jnp.where(accept & write, 1, 0)
+        stopped = stopped | (~accept & write)   # reject OR bonus ends the run
+        return (stopped, n_acc, recent, nvalid, toks, key)
+
+    # Initial carry DERIVED from the inputs so it inherits their
+    # varying-axis types under shard_map (a literal jnp.zeros carry would
+    # be device-invariant while the loop body's outputs vary over e.g. the
+    # ring's "stage" axis — lax.fori_loop rejects the mismatch).
+    nv0 = jnp.asarray(num_valid, jnp.int32)
+    zero = nv0 * 0
+    toks0 = jnp.zeros((k + 1,), jnp.int32) + zero
+    stopped, n_acc, recent, nvalid, toks, _ = jax.lax.fori_loop(
+        0, k + 1, body,
+        (zero < 0, zero, jnp.asarray(recent_tokens), nv0, toks0, key))
+    return toks, n_acc, recent, nvalid
+
+
 def sample_token(
     rng: jax.Array,
     logits: jnp.ndarray,

@@ -77,6 +77,53 @@ def test_ring_sessions_cli_matches_single_session_fused(capsys):
         f"{ring_texts} vs {singles}")
 
 
+def test_ring_sessions_speculative_cli_matches_plain_ring(capsys):
+    """--ring_sessions x --speculative_k compose: drafted tokens ride the
+    rotation and greedy output is token-identical to the non-speculative
+    ring (the speculative invariant), with the acceptance stat printed."""
+    common = ["--mode", "fused", "--num_stages", "2", "--ring_sessions", "2",
+              "--model", "gpt2", "--max_new_tokens", "6",
+              "--temperature", "0", "--prompt", "hi||yo"]
+    rc = main(common)
+    assert rc == 0 or rc is None
+    plain = [b.splitlines()[1] for b in
+             capsys.readouterr().out.split("=== Session ")[1:]]
+
+    rc = main(common + ["--speculative_k", "3"])
+    assert rc == 0 or rc is None
+    out = capsys.readouterr().out
+    spec = [b.splitlines()[1] for b in out.split("=== Session ")[1:]]
+    assert spec == plain, (
+        f"speculative ring diverged from plain ring: {spec} vs {plain}")
+    assert "Speculative:" in out and "rounds" in out
+
+
+@pytest.mark.parity
+def test_ring_sessions_sampled_cli_matches_oracle(capsys):
+    """temperature > 0 ring serving runs the FULL reference sampler inside
+    the rotation: each session's text must equal --mode oracle (the fused
+    sampled engine) for its prompt at the same seed."""
+    common = ["--model", "gpt2", "--max_new_tokens", "5",
+              "--temperature", "0.8", "--top_p", "0.9", "--top_k", "20",
+              "--repetition_penalty", "1.3", "--seed", "17"]
+    singles = []
+    for p in ("hi", "yo"):
+        rc = main(["--mode", "oracle", "--prompt", p] + common)
+        assert rc == 0 or rc is None
+        out = capsys.readouterr().out
+        singles.append(out.split("===")[2].splitlines()[1])
+
+    rc = main(["--mode", "fused", "--num_stages", "2",
+               "--ring_sessions", "2", "--prompt", "hi||yo"] + common)
+    assert rc == 0 or rc is None
+    out = capsys.readouterr().out
+    blocks = out.split("=== Session ")[1:]
+    ring_texts = [b.splitlines()[1] for b in blocks]
+    assert ring_texts == singles, (
+        f"sampled ring sessions diverged from the oracle sampler: "
+        f"{ring_texts} vs {singles}")
+
+
 def test_status_mode_coverage_summary(capsys):
     """--mode status prints live records + the per-block coverage summary
     (the reference's get_remote_module_infos log, src/dht_utils.py:227-240)

@@ -183,3 +183,243 @@ def test_ring_decode_rejects_fewer_groups_than_stages():
     pipe = IciPipeline.build(cfg, params, num_stages=4, num_micro=2)
     with pytest.raises(ValueError, match="sessions >= stages"):
         RingDecoder.build(pipe)
+
+
+# ---------------------------------------------------------------------------
+# Sampled ring decode: the full reference sampler inside the rotation
+# ---------------------------------------------------------------------------
+
+def _sp_args(sp):
+    return (jnp.asarray(sp.temperature, jnp.float32),
+            jnp.asarray(sp.top_p, jnp.float32),
+            jnp.asarray(sp.top_k, jnp.int32),
+            jnp.asarray(sp.repetition_penalty, jnp.float32))
+
+
+def oracle_sampled(cfg, params, prompt, n_tokens, seed, sp, row=0,
+                   max_len=48):
+    """Single-session unpartitioned SAMPLED loop with the fused sampled
+    engine's exact key schedule: token i uses PRNGKey(seed + i), row > 0
+    folds the row index (executor._sample_rows contract)."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.ops.sampling import (
+        make_recent_buffer,
+        push_recent,
+        sample_token,
+    )
+
+    def key(i):
+        base = jax.random.PRNGKey(seed + i)
+        return base if row == 0 else jax.random.fold_in(base, row)
+
+    args = _sp_args(sp)
+    kc, vc = init_kv_cache(cfg, cfg.num_layers, 1, max_len)
+    ids = jnp.asarray(np.asarray(prompt, np.int32)[None])
+    logits, kc, vc = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
+    recent, nvalid = make_recent_buffer()
+    tok = sample_token(key(0), logits[0, -1], recent, nvalid, *args)
+    recent, nvalid = push_recent(recent, nvalid, tok)
+    toks = [int(tok)]
+    cur = len(prompt)
+    for i in range(1, n_tokens):
+        logits, kc, vc = full_forward(
+            cfg, params, jnp.asarray([[toks[-1]]], jnp.int32), kc, vc,
+            jnp.int32(cur))
+        cur += 1
+        tok = sample_token(key(i), logits[0, -1], recent, nvalid, *args)
+        recent, nvalid = push_recent(recent, nvalid, tok)
+        toks.append(int(tok))
+    return toks
+
+
+@pytest.mark.parametrize("num_stages,num_groups,slot_b", [
+    (4, 4, 1),    # batch-1 fast path (unfolded key)
+    (2, 3, 2),    # vmapped rows with folded keys
+])
+def test_ring_sampled_matches_per_session_oracle(num_stages, num_groups,
+                                                 slot_b):
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.ops.sampling import (
+        RECENT_WINDOW,
+        SamplingParams,
+        push_recent,
+        sample_token,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    S, G, B = num_stages, num_groups, slot_b
+    pipe = IciPipeline.build(cfg, params, S, num_micro=G)
+    rd = RingDecoder.build(pipe, max_steps=16, sampled=True)
+    sp = SamplingParams(temperature=0.8, top_p=0.9, top_k=20,
+                        repetition_penalty=1.5)
+    seed = 11
+    args = _sp_args(sp)
+
+    rng = np.random.default_rng(5)
+    t, n_tokens = 5, 8
+    ids = _prompts(rng, G, B, t, cfg.vocab_size)
+    k, v = pipe.init_kv(B, max_len=48)
+    logits, k, v = pipe.forward(jnp.asarray(ids), k, v, jnp.int32(0))
+
+    # First token per session: key schedule step 0 on the prefill logits.
+    tok0 = np.zeros((G, B), np.int32)
+    recent = np.zeros((G, B, RECENT_WINDOW), np.int32)
+    nvalid = np.zeros((G, B), np.int32)
+    for g in range(G):
+        for b in range(B):
+            base = jax.random.PRNGKey(seed)
+            kb = base if b == 0 else jax.random.fold_in(base, b)
+            tok = sample_token(kb, logits[g, b, -1].astype(jnp.float32),
+                               jnp.asarray(recent[g, b]),
+                               jnp.asarray(nvalid[g, b]), *args)
+            r2, n2 = push_recent(jnp.asarray(recent[g, b]),
+                                 jnp.asarray(nvalid[g, b]), tok)
+            tok0[g, b] = int(tok)
+            recent[g, b], nvalid[g, b] = np.asarray(r2), int(n2)
+
+    lens = jnp.full((G,), t, jnp.int32)
+    toks, k, v, recent2, nvalid2 = rd.decode_sampled(
+        jnp.asarray(tok0), k, v, lens, n_tokens - 1,
+        seed_base=jnp.full((G,), seed + 1, jnp.int32),
+        recent=jnp.asarray(recent), nvalid=jnp.asarray(nvalid),
+        temps=jnp.full((G,), sp.temperature, jnp.float32),
+        top_ps=jnp.full((G,), sp.top_p, jnp.float32),
+        top_ks=jnp.full((G,), sp.top_k, jnp.int32),
+        reps=jnp.full((G,), sp.repetition_penalty, jnp.float32))
+    toks = np.asarray(toks)
+
+    for g in range(G):
+        for b in range(B):
+            ref = oracle_sampled(cfg, params, ids[g, b], n_tokens, seed, sp,
+                                 row=b)
+            got = [int(tok0[g, b])] + toks[: n_tokens - 1, g, b].tolist()
+            assert got == ref, (
+                f"sampled session (g={g}, b={b}) diverged: ring {got} "
+                f"vs oracle {ref}")
+    # Sampler state threads out for chunked continuation.
+    assert np.asarray(nvalid2).min() == n_tokens
+
+
+def test_ring_sampled_chunked_matches_single_call():
+    """Sampler state (recent window + key schedule offset) must thread
+    exactly across chunk boundaries."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.ops.sampling import (
+        RECENT_WINDOW,
+        SamplingParams,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    S, G, B, t = 2, 2, 1, 4
+    pipe = IciPipeline.build(cfg, params, S, num_micro=G)
+    rd = RingDecoder.build(pipe, max_steps=8, sampled=True)
+    sp = SamplingParams(temperature=0.7, top_p=0.95, top_k=40,
+                        repetition_penalty=1.3)
+    seed = 23
+    rng = np.random.default_rng(9)
+    ids = jnp.asarray(_prompts(rng, G, B, t, cfg.vocab_size))
+
+    k, v = pipe.init_kv(B, max_len=48)
+    logits, k, v = pipe.forward(ids, k, v, jnp.int32(0))
+    tok0 = jnp.argmax(
+        logits[:, :, -1].astype(jnp.float32), -1).astype(jnp.int32)
+    lens = jnp.full((G,), t, jnp.int32)
+    recent0 = jnp.zeros((G, B, RECENT_WINDOW), jnp.int32)
+    nvalid0 = jnp.zeros((G, B), jnp.int32)
+    kw = dict(temps=jnp.full((G,), sp.temperature, jnp.float32),
+              top_ps=jnp.full((G,), sp.top_p, jnp.float32),
+              top_ks=jnp.full((G,), sp.top_k, jnp.int32),
+              reps=jnp.full((G,), sp.repetition_penalty, jnp.float32))
+
+    k1, v1 = jax.tree.map(jnp.copy, (k, v))
+    one, _, _, _, _ = rd.decode_sampled(
+        tok0, k1, v1, lens, 6, seed_base=jnp.full((G,), seed, jnp.int32),
+        recent=recent0, nvalid=nvalid0, **kw)
+
+    k2, v2 = jax.tree.map(jnp.copy, (k, v))
+    a, k2, v2, r2, n2 = rd.decode_sampled(
+        tok0, k2, v2, lens, 3, seed_base=jnp.full((G,), seed, jnp.int32),
+        recent=recent0, nvalid=nvalid0, **kw)
+    b_, _, _, _, _ = rd.decode_sampled(
+        a[2], k2, v2, lens + 3, 3,
+        seed_base=jnp.full((G,), seed + 3, jnp.int32), recent=r2,
+        nvalid=n2, **kw)
+
+    got = np.concatenate([np.asarray(a[:3]), np.asarray(b_[:3])])
+    np.testing.assert_array_equal(got, np.asarray(one[:6]))
+
+
+# ---------------------------------------------------------------------------
+# Ring x speculative: drafted tokens ride the rotation, verified in-program
+# ---------------------------------------------------------------------------
+
+def test_ring_spec_round_greedy_output_independent_of_drafts():
+    """The speculative invariant: greedy output must be token-identical to
+    plain greedy decoding for ANY draft quality — perfect drafts (all
+    accepted, K+1 tokens/round), garbage drafts (all rejected, 1
+    token/round), and anything between only change the SPEED."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.ops.sampling import (
+        RECENT_WINDOW,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.parallel.ring_decode import (
+        make_ring_spec_round,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    S, G, K, t, n_tokens = 2, 3, 3, 4, 8
+    pipe = IciPipeline.build(cfg, params, S, num_micro=G)
+    round_fn = make_ring_spec_round(pipe, K)
+
+    rng = np.random.default_rng(2)
+    ids = _prompts(rng, G, 1, t, cfg.vocab_size)
+    refs = [oracle_greedy(cfg, params, ids[g, 0], n_tokens)
+            for g in range(G)]
+
+    k, v = pipe.init_kv(1, max_len=48)
+    logits, k, v = pipe.forward(jnp.asarray(ids), k, v, jnp.int32(0))
+    tok0 = np.asarray(jnp.argmax(
+        logits[:, :, -1].astype(jnp.float32), -1)).astype(np.int32)
+
+    sessions = [[int(tok0[g, 0])] for g in range(G)]
+    lens = np.full((G,), t, np.int32)
+    recent = jnp.zeros((G, 1, RECENT_WINDOW), jnp.int32)
+    nvalid = jnp.zeros((G, 1), jnp.int32)
+    kw = dict(temps=jnp.zeros((G,), jnp.float32),       # greedy
+              top_ps=jnp.full((G,), 0.9, jnp.float32),
+              top_ks=jnp.full((G,), 20, jnp.int32),
+              reps=jnp.full((G,), 1.3, jnp.float32))
+    rounds = 0
+    while any(len(s) < n_tokens for s in sessions):
+        tokens_in = np.zeros((G, 1, K + 1), np.int32)
+        for g in range(G):
+            done = len(sessions[g])
+            tokens_in[g, 0, 0] = sessions[g][-1]
+            if g == 0:      # perfect drafts: the oracle's next tokens
+                fut = refs[g][done:done + K]
+                tokens_in[g, 0, 1:1 + len(fut)] = fut
+            elif g == 1:    # garbage drafts (all-rejected path)
+                tokens_in[g, 0, 1:] = (np.asarray(refs[g][:K]) + 7) % 257
+            else:           # half-decent drafts: first right, rest wrong
+                fut = refs[g][done:done + 1]
+                tokens_in[g, 0, 1:1 + len(fut)] = fut
+        toks, nacc, k, v, recent, nvalid = round_fn(
+            tokens_in, k, v, lens, seed_base=np.full((G,), 5, np.int32),
+            recent=recent, nvalid=nvalid, **kw)
+        toks, nacc = np.asarray(toks), np.asarray(nacc)
+        rounds += 1
+        for g in range(G):
+            if len(sessions[g]) >= n_tokens:
+                continue
+            na = int(nacc[g, 0])
+            sessions[g].extend(int(x) for x in toks[g, 0, : na + 1])
+            lens[g] += na + 1
+        assert rounds < 4 * n_tokens, "spec rounds failed to make progress"
+
+    for g in range(G):
+        assert sessions[g][:n_tokens] == refs[g], (
+            f"session {g} diverged under speculative rounds: "
+            f"{sessions[g][:n_tokens]} vs {refs[g]}")
+    # Perfect-draft session must have taken big strides (accept > 0).
+    assert rounds < n_tokens, (
+        "perfect drafts never accepted: rounds should be well under "
+        "one-per-token")
