@@ -152,7 +152,12 @@ def param_bytes(params) -> int:
 
 def bench_config(name, cfg, params, *, batch, max_len, s1, s2, prefill=64,
                  reps=4, sustained_gbps=None):
-    """Slope-timed fused decode: returns a per-config result dict."""
+    """Slope-timed fused decode: returns a per-config result dict.
+
+    If ``cfg.decode_kv_page`` is set, the per-step KV bytes MOVED are
+    accounted per the paged read pattern (mean occupied pages over the S2
+    run) instead of the full static bucket — what the paged attention
+    actually streams."""
     @jax.jit
     def do_prefill(params, ids, kc, vc):
         logits, kc, vc = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
@@ -192,9 +197,20 @@ def bench_config(name, cfg, params, *, batch, max_len, s1, s2, prefill=64,
     kv_bytes = (2 * cfg.num_layers * batch * occ * cfg.num_kv_heads
                 * cfg.head_dim * 2)  # bf16
     required = wbytes + kv_bytes
-    # What the step ACTUALLY moves: the attention streams the whole static
-    # cache bucket, not just the occupied prefix.
-    kv_padded = (2 * cfg.num_layers * batch * max_len * cfg.num_kv_heads
+    # What the step ACTUALLY moves: the one-pass attention streams the
+    # whole static cache bucket; the paged attention streams only occupied
+    # pages (mean over the S2 run). The paged accounting applies ONLY when
+    # the model's gate (transformer._attention) actually takes the paged
+    # path — otherwise 'moved' would describe reads that never happened.
+    page = getattr(cfg, "decode_kv_page", 0)
+    if page and (max_len % page or cfg.sliding_window is not None):
+        page = 0
+    if page:
+        read_rows = float(np.mean(
+            [np.ceil((prefill + i + 1) / page) * page for i in range(s2)]))
+    else:
+        read_rows = float(max_len)
+    kv_padded = (2 * cfg.num_layers * batch * read_rows * cfg.num_kv_heads
                  * cfg.head_dim * 2)
     moved = wbytes + kv_padded
     bw = spec_bw_gbps() * 1e9
@@ -681,9 +697,14 @@ def bench_ring_causal_skip(p=8, b=1, h=8, hkv=4, dh=64, c=512, reps=3):
         make_ring_attention_fn,
     )
 
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.parallel.ring_attention import (
+        make_zigzag_ring_attention_fn,
+    )
+
     mesh = Mesh(np_.asarray(jax.devices()[:p]), ("sp",))
     fn_skip = make_ring_attention_fn(mesh)
     fn_full = make_ring_attention_fn(mesh, skip_masked_blocks=False)
+    fn_zig = make_zigzag_ring_attention_fn(mesh)
     key = jax.random.PRNGKey(0)
     t = p * c
     q = jax.random.normal(key, (b, t, h, dh), jnp.bfloat16)
@@ -701,19 +722,36 @@ def bench_ring_causal_skip(p=8, b=1, h=8, hkv=4, dh=64, c=512, reps=3):
 
     t_full = timed(fn_full)
     t_skip = timed(fn_skip)
+    t_zig = timed(fn_zig)
+    # Per-device block-compute counts are schedule arithmetic (exact, not
+    # measured): contiguous causal-skip device i does i+1 blocks; zigzag
+    # device i does (sum over sources of [s<=i] + 1 + [s>=i]) / 4 = a flat
+    # (2p+1)/4. The serialized backend's wall only sees TOTALS, so the
+    # spread is reported from the schedule and the totals from the clock.
+    contiguous_blocks = [i + 1 for i in range(p)]
+    zig_blocks = [sum((1 if s <= i else 0) + 1 + (1 if s >= i else 0)
+                      for s in range(p)) / 4 for i in range(p)]
     return {
         "devices": p, "chunk": c, "seq": t,
         "full_ring_ms": round(t_full * 1e3, 1),
         "causal_skip_ms": round(t_skip * 1e3, 1),
+        "zigzag_ms": round(t_zig * 1e3, 1),
         "work_ratio_measured": round(t_skip / t_full, 3),
         "work_ratio_theory": round((p + 1) / (2 * p), 4),
+        "zigzag_work_ratio_measured": round(t_zig / t_full, 3),
+        "zigzag_work_ratio_theory": round((2 * p + 1) / (4 * p), 4),
+        "per_device_blocks_contiguous": contiguous_blocks,
+        "per_device_blocks_zigzag": zig_blocks,
+        "critical_path_blocks": {"contiguous": max(contiguous_blocks),
+                                 "zigzag": max(zig_blocks)},
         "backend": jax.devices()[0].platform,
         "note": ("virtual-mesh structural row: serialized-backend wall = "
-                 "total device work; fixed overhead biases the ratio toward "
-                 "1 (conservative). Latency on real hardware still spans "
-                 "P-1 rotations (last device computes every step); the "
-                 "win is total FLOPs/energy and freed per-step slack on "
-                 "early devices"),
+                 "total device work; fixed overhead biases ratios toward 1 "
+                 "(conservative). Contiguous causal-skip leaves the LAST "
+                 "device computing every rotation (critical path p blocks); "
+                 "the zigzag layout flattens per-device work to (2p+1)/4 "
+                 "block-equivalents at the same ~0.5 total-work ratio "
+                 "(parity: tests/test_ring_attention.py)"),
     }
 
 
@@ -991,6 +1029,20 @@ def main():
             gcfg, gparams)
     except Exception as exc:   # the serving row must not kill the bench
         results["gpt2_serving_batched_8slots"] = {"error": str(exc)[:200]}
+    # Quantized SERVING row (VERDICT r4 next-round item 1): the same
+    # batched engine a `--mode serve --batched --quant int8` server runs,
+    # with int8 weight-only params (QuantizedTensor leaves dequantize per
+    # layer inside the jitted step; token parity vs the dequantized twin
+    # is pinned by tests/test_quant.py).
+    try:
+        from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.quant import (
+            quantize_params as _qp,
+        )
+
+        results["gpt2_serving_batched_8slots_int8"] = bench_serving_batched(
+            gcfg, _qp(gparams, "int8"))
+    except Exception as exc:
+        results["gpt2_serving_batched_8slots_int8"] = {"error": str(exc)[:200]}
     results["gpt2_prefill_b8_s512"] = bench_prefill(
         gcfg, gparams, batch=8, seq=512)
     del gparams
@@ -1023,6 +1075,31 @@ def main():
         del qparams
     except Exception as exc:   # the quant row must not kill the bench
         results["flagship_1b_b16_int8"] = {"error": str(exc)[:200]}
+    # Paged decode reads (VERDICT r4 item 5): T==1 attention streams only
+    # occupied cache pages (ops.attention.paged_decode_attention), so HBM
+    # reads track occupancy instead of the 512-row bucket. Token parity:
+    # tests/test_paged_attention.py.
+    try:
+        import dataclasses as _dc
+
+        pcfg = _dc.replace(fcfg, decode_kv_page=64)
+        results["flagship_1b_b16_paged64"] = bench_config(
+            "flagship_1b_b16_paged64", pcfg, fparams, batch=16, max_len=512,
+            s1=S1, s2=S2, sustained_gbps=sustained)
+    except Exception as exc:
+        results["flagship_1b_b16_paged64"] = {"error": str(exc)[:200]}
+    # nf4 weight-only (VERDICT r4 item 1): 4.25 bits/weight quarters the
+    # weight stream the b16 roofline breakdown names as the binding term;
+    # the per-layer dequant (codebook gather + scale) costs FLOPs the MXU
+    # has to spare at decode. param_bytes counts packed+scale bytes.
+    try:
+        qparams = quantize_params(fparams, "nf4")
+        results["flagship_1b_b16_nf4"] = bench_config(
+            "flagship_1b_b16_nf4", fcfg, qparams, batch=16, max_len=512,
+            s1=S1, s2=S2, sustained_gbps=sustained)
+        del qparams
+    except Exception as exc:
+        results["flagship_1b_b16_nf4"] = {"error": str(exc)[:200]}
     del fparams
 
     # BASELINE config #5: microbatched deep-pipeline decode (subprocess on

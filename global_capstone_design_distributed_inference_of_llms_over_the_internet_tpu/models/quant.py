@@ -50,6 +50,27 @@ NF4_LEVELS = (
 NF4_BLOCK = 64   # weights per absmax block (QLoRA default)
 
 
+def _lut16(codes: jnp.ndarray, table) -> jnp.ndarray:
+    """16-entry lookup as a 4-level SELECT TREE (15 elementwise wheres on
+    the code bits) instead of a per-element gather. Measured on a v5e:
+    `jnp.take` over the 16-entry table lowered to a real gather and made
+    nf4 flagship decode 8x SLOWER than bf16 (32.7 ms/step vs 4.1); the
+    select tree vectorizes on the VPU and fuses into the consumer. codes:
+    int32 [...] in [0, 16). Returns f32 of the same shape."""
+    b0 = (codes & 1).astype(bool)
+    b1 = (codes & 2).astype(bool)
+    b2 = (codes & 4).astype(bool)
+    b3 = (codes & 8).astype(bool)
+    # bf16 intermediates: the tree is VPU-bandwidth-bound, and the 16
+    # level constants round-trip bf16 with < 0.4% error — far under the
+    # 4-bit quantization error itself. The consumer upcasts as needed.
+    lvl = [jnp.bfloat16(t) for t in table]
+    l1 = [jnp.where(b0, lvl[2 * i + 1], lvl[2 * i]) for i in range(8)]
+    l2 = [jnp.where(b1, l1[2 * i + 1], l1[2 * i]) for i in range(4)]
+    l3 = [jnp.where(b2, l2[2 * i + 1], l2[2 * i]) for i in range(2)]
+    return jnp.where(b3, l3[1], l3[0]).astype(jnp.float32)
+
+
 @jax.tree_util.register_pytree_node_class
 class QuantizedTensor:
     """int8 weight + per-output-channel fp32 scale.
@@ -118,14 +139,13 @@ class NF4Tensor:
         return (*self.packed.shape[:-2], self.in_dim, self.packed.shape[-1])
 
     def dequant(self) -> jnp.ndarray:
-        table = jnp.asarray(NF4_LEVELS, jnp.float32)
         high = (self.packed >> 4).astype(jnp.int32)
         low = (self.packed & 0xF).astype(jnp.int32)
         codes = jnp.stack([high, low], axis=-2)        # [..., P, 2, out]
         lead = self.packed.shape[:-2]
         out = self.packed.shape[-1]
         in_pad = self.packed.shape[-2] * 2
-        vals = jnp.take(table, codes.reshape(*lead, in_pad, out), axis=0)
+        vals = _lut16(codes.reshape(*lead, in_pad, out), NF4_LEVELS)
         nb = in_pad // NF4_BLOCK
         vals = vals.reshape(*lead, nb, NF4_BLOCK, out)
         vals = vals * self.scales[..., :, None, :].astype(jnp.float32)

@@ -310,9 +310,19 @@ def _attention(
         )
     else:
         k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, cache_len)
-        out = cached_attention(
-            q, k_cache, v_cache, cache_len, sliding_window=cfg.sliding_window
-        )
+        if (cfg.decode_kv_page and t == 1 and cfg.sliding_window is None
+                and k_cache.shape[1] % cfg.decode_kv_page == 0):
+            # Occupancy-tracking decode reads (VERDICT r4 item 5): only
+            # pages holding real rows stream from HBM.
+            from ..ops.attention import paged_decode_attention
+
+            out = paged_decode_attention(q, k_cache, v_cache, cache_len,
+                                         cfg.decode_kv_page)
+        else:
+            out = cached_attention(
+                q, k_cache, v_cache, cache_len,
+                sliding_window=cfg.sliding_window
+            )
     y = out.reshape(b, t, h_local * dh) @ p["wo"]
     y = _psum_if(y, tp_axis)
     if "bo" in p:

@@ -286,3 +286,75 @@ def test_nf4_sizing_matches_4_25_bits():
     assert (choose_num_blocks(cfg, budget, quant="nf4")
             >= choose_num_blocks(cfg, budget, quant="int8")
             >= choose_num_blocks(cfg, budget, dtype_bytes=2))
+
+
+def test_quantized_fused_decode_matches_dequantized_fused():
+    """The fused multi-step decode engine (the bench's flagship path) must
+    produce the same greedy tokens whether QuantizedTensor leaves
+    dequantize inside the scan or the dequantized weights are materialized
+    up front — for BOTH int8 and nf4."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+        full_forward,
+        init_kv_cache,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.fused_decode import (
+        make_fused_decode,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, cfg.vocab_size, 6).astype(np.int32)
+
+    for mode in ("int8", "nf4"):
+        qparams = quantize_params(params, mode)
+        dparams = dequant_tree(qparams)   # materialized reference
+
+        def run(p):
+            fn = make_fused_decode(cfg, 8, 1, exact_head=True)
+            kc, vc = init_kv_cache(cfg, cfg.num_layers, 1, 64)
+            logits, kc, vc = full_forward(cfg, p, jnp.asarray(prompt[None]),
+                                          kc, vc, jnp.int32(0))
+            tok = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+            toks, _, _ = fn(p, tok, kc, vc, jnp.int32(len(prompt)),
+                            jnp.int32(8))
+            return [int(tok[0])] + np.asarray(toks[:, 0]).tolist()
+
+        assert run(qparams) == run(dparams), f"{mode} fused decode diverged"
+
+
+def test_quantized_batched_serving_matches_dequantized():
+    """The batched serving engine (the --mode serve --batched path that a
+    --quant server runs) must match its dequantized twin token-for-token."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.partition import (
+        ROLE_FULL,
+        StageSpec,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.batching import (
+        BatchedStageExecutor,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    qparams = quantize_params(params, "int8")
+    dparams = dequant_tree(qparams)
+    spec = StageSpec(index=0, role=ROLE_FULL, start=0, end=cfg.num_layers)
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, cfg.vocab_size, 5).astype(np.int32)
+               for _ in range(2)]
+
+    def serve(p):
+        ex = BatchedStageExecutor(cfg, spec, p, slots=2, max_len=32)
+        toks = {}
+        for s, prompt in enumerate(prompts):
+            h = ex.prefill(f"s{s}", prompt[None, :])
+            toks[f"s{s}"] = [int(jnp.argmax(ex.logits(h[:, -1:])[0, -1]))]
+        for _ in range(5):
+            out = ex.decode_batch({
+                sid: jnp.asarray([[t[-1]]], jnp.int32)
+                for sid, t in toks.items()})
+            for sid in toks:
+                toks[sid].append(int(jnp.argmax(out[sid][0, -1])))
+        return toks
+
+    assert serve(qparams) == serve(dparams)

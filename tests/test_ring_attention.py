@@ -82,3 +82,46 @@ def test_ring_bf16_activation_dtype_roundtrip():
         np.asarray(out, np.float32), np.asarray(want, np.float32),
         atol=3e-2, rtol=3e-2,
     )
+
+
+def test_zigzag_matches_contiguous_ring():
+    """The zigzag layout is a pure work-BALANCE change: outputs must match
+    the contiguous causal ring (and thus single-device attention) for the
+    same natural-order inputs."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.parallel.ring_attention import (
+        make_ring_attention_fn,
+        make_zigzag_ring_attention_fn,
+    )
+
+    p = 4
+    mesh = Mesh(np.asarray(jax.devices()[:p]), ("sp",))
+    rng = np.random.default_rng(0)
+    b, t, h, hkv, dh = 2, 8 * p * 2, 4, 2, 16
+    q = jnp.asarray(rng.standard_normal((b, t, h, dh)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, hkv, dh)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, hkv, dh)), jnp.float32)
+
+    ref = make_ring_attention_fn(mesh)(q, k, v)
+    got = make_zigzag_ring_attention_fn(mesh)(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_zigzag_per_device_work_balanced():
+    """Schedule arithmetic: the contiguous causal skip gives device i
+    (i+1) block-computes (spread 1..P); zigzag gives every device
+    2P+1 half-pairs = (2P+1)/4 block-equivalents, identical across
+    devices, at the same-or-less total work."""
+    P_ = 8
+    contiguous = [(i + 1) for i in range(P_)]           # blocks per device
+    zigzag = []
+    for i in range(P_):
+        pairs = 0
+        for s in range(P_):                             # incoming source s
+            pairs += (1 if s <= i else 0) + 1 + (1 if s >= i else 0)
+        zigzag.append(pairs / 4)                        # half-pair = 1/4 blk
+    assert max(contiguous) - min(contiguous) == P_ - 1  # skewed 1..P
+    assert max(zigzag) - min(zigzag) <= 0.25            # balanced (+-1 pair)
+    assert sum(zigzag) <= sum(contiguous)               # total work no worse
+    # Critical path (slowest device) drops ~2x at P=8.
+    assert max(zigzag) < 0.6 * max(contiguous)
