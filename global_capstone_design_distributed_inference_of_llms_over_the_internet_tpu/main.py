@@ -1131,6 +1131,55 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _print_swarm_health(infos: dict, total_servers: int = 0) -> None:
+    """Swarm-wide aggregation of the per-server request-log rings (the
+    ``info`` verb's ``recent_requests`` tail): top error peers, slowest
+    hops, cache pressure — one operator surface instead of grepping N
+    server logs (exceeds the reference's announcer/log story,
+    ``petals/server/handler.py:549-592``, ``server.py:721-726``)."""
+    if not infos:
+        return
+    unreachable = max(0, total_servers - len(infos))
+    print(f"swarm health ({len(infos)}/{total_servers or len(infos)} "
+          "server rings probed):")
+    if unreachable:
+        # An unreachable server is the LIKELIEST one erroring — never let
+        # a clean aggregate of the reachable rings read as all-clear.
+        print(f"  WARNING: {unreachable} server(s) unreachable for info — "
+              "their rings are NOT included below")
+    errs = []     # (count, peer, last error record)
+    slows = []    # (max_dur_ms, peer, verb)
+    for peer, inf in infos.items():
+        recs = inf.get("recent_requests") or []
+        bad = [r for r in recs if r.get("outcome") != "ok"]
+        if bad:
+            errs.append((len(bad), peer, bad[-1]))
+        durs = [(r.get("dur_ms"), r.get("verb")) for r in recs
+                if r.get("dur_ms") is not None]
+        if durs:
+            d, v = max(durs)
+            slows.append((d, peer, v))
+    if errs:
+        errs.sort(reverse=True)
+        for n, peer, last in errs[:3]:
+            print(f"  errors: {peer} x{n} (last: {last.get('verb')} "
+                  f"{last.get('outcome')} {last.get('detail', '')})")
+    else:
+        print(f"  errors: none in the {len(infos)} probed ring(s)")
+    if slows:
+        slows.sort(reverse=True)
+        print("  slowest hops: " + ", ".join(
+            f"{peer} {d:.1f}ms ({v})" for d, peer, v in slows[:3]))
+    pressure = [(inf.get("cache_tokens_left"), peer)
+                for peer, inf in infos.items()
+                if inf.get("cache_tokens_left") is not None]
+    if pressure:
+        lo, lo_peer = min(pressure)
+        print(f"  cache pressure: min {lo} tokens left ({lo_peer}); "
+              f"total {sum(p for p, _ in pressure)} across "
+              f"{len(pressure)} server(s)")
+
+
 def run_status(args) -> int:
     """Swarm inspector: live records, per-block coverage summary (the
     reference's ``get_remote_module_infos`` coverage log,
@@ -1158,11 +1207,13 @@ def run_status(args) -> int:
     for r in records:
         snap.register(r)
     tx = TcpTransport(snap, wire_dtype=args.wire_dtype)
+    infos = {}
     for r in sorted(records, key=lambda r: (r.start_block, r.peer_id)):
         extra = ""
         if r.address:
             try:
                 inf = tx.info(r.peer_id, timeout=3.0)
+                infos[r.peer_id] = inf
                 extra = (f" served={inf.get('requests_served')}"
                          f" rtt_probe_ok")
             except Exception as exc:
@@ -1193,6 +1244,7 @@ def run_status(args) -> int:
     print("coverage: " + prefix + ", ".join(
         f"[{a},{b})x{n}" + ("  <-- UNCOVERED" if n == 0 else "")
         for a, b, n in runs))
+    _print_swarm_health(infos, total_servers=len(records))
     tx.close()
     healthy = all(n > 0 for _, _, n in runs)
     if not any(r.final_stage for r in records):

@@ -209,11 +209,23 @@ def _decode_tensor(meta: dict, payload: bytes) -> np.ndarray:
     return np.frombuffer(payload, np.float32).reshape(shape).copy()
 
 
-def _encode_tensors(arrs, wire_dtype: str) -> Tuple[list, bytes]:
-    """Pack several tensors into one payload; each meta gains 'nbytes'."""
+def _encode_tensors(arrs, wire_dtype) -> Tuple[list, bytes]:
+    """Pack several tensors into one payload; each meta gains 'nbytes'.
+
+    ``wire_dtype`` may be one string (uniform) or a PER-TENSOR list — the
+    petals handler's schema-driven per-tensor compression choice
+    (``petals/server/handler.py:411-432``): e.g. activations ride bf16
+    while learned prompts / gradients in the same payload stay f32. The
+    decode side needs no flag — every meta already records its own dtype.
+    """
+    if isinstance(wire_dtype, str):
+        wire_dtype = [wire_dtype] * len(arrs)
+    if len(wire_dtype) != len(arrs):
+        raise WireError(
+            f"{len(wire_dtype)} wire dtypes for {len(arrs)} tensors")
     metas, chunks = [], []
-    for arr in arrs:
-        meta, body = _encode_tensor(np.asarray(arr), wire_dtype)
+    for arr, wd in zip(arrs, wire_dtype):
+        meta, body = _encode_tensor(np.asarray(arr), wd)
         meta["nbytes"] = len(body)
         metas.append(meta)
         chunks.append(body)
@@ -1095,9 +1107,16 @@ class TcpTransport(Transport):
             sock.settimeout(timeout)
             if request.train:
                 arrs = [np.asarray(request.hidden)]
+                # Per-tensor schema (petals handler.py:411-432): the
+                # activation rides the session wire dtype; learned PROMPTS
+                # stay f32 — they are trainable parameters, and bf16-
+                # rounding them on every step would quantize the tuning
+                # signal itself.
+                wds = [self.wire_dtype]
                 if request.prompts is not None:
                     arrs.append(np.asarray(request.prompts))
-                metas, body = _encode_tensors(arrs, self.wire_dtype)
+                    wds.append("f32")
+                metas, body = _encode_tensors(arrs, wds)
                 hdr = {
                     "verb": "train_forward",
                     "session_id": request.session_id,
@@ -1110,10 +1129,11 @@ class TcpTransport(Transport):
             elif request.prompts is not None:
                 # Deep-prompt inference step: prompts ride as a second
                 # payload tensor (classic frame — never streamed/pushed,
-                # matching petals' can_push = not has_prompts).
+                # matching petals' can_push = not has_prompts). Per-tensor
+                # schema: activation at the session wire dtype, prompts f32.
                 metas, body = _encode_tensors(
                     [np.asarray(request.hidden), np.asarray(request.prompts)],
-                    self.wire_dtype)
+                    [self.wire_dtype, "f32"])
                 hdr = _request_header(request, metas[0],
                                       prompts_meta=metas[1])
                 hdr["wire_dtype"] = self.wire_dtype

