@@ -233,6 +233,28 @@ def run_local(args, cfg: ModelConfig, params) -> int:
     return _generate_and_report(args, client.generate, cfg)
 
 
+def _maybe_quantize(args, params, tp: int = 1):
+    """Apply ``--quant`` weight-only quantization (int8 measured +26%
+    decode tokens/s on-chip — docs/PERFORMANCE.md): QuantizedTensor/
+    NF4Tensor leaves ride the layer trees and dequantize per layer inside
+    the scans; embed/head stay full precision. Rejected with tp > 1 on
+    the fused path: the megatron sharding tables key on leaf names that
+    quantized pytree nodes hide, so the q/s leaves would replicate over
+    tp and the closing psum would scale every projection by tp — the same
+    silent corruption the TP stage engine guards against
+    (parallel/tensor_parallel.py shard tables)."""
+    if getattr(args, "quant", "none") == "none":
+        return params
+    if tp > 1:
+        raise SystemExit(
+            "--quant is not supported with --tp > 1 on the fused/ring "
+            "path (quantized leaves cannot be megatron-sharded; run "
+            "tp=1, or serve full-precision TP)")
+    from .models.quant import quantize_params
+
+    return quantize_params(params, args.quant)
+
+
 def run_fused(args, cfg: ModelConfig, params) -> int:
     """Fused ICI pipeline generation (microbatch=1 stream for the CLI), or
     — with ``--ring_sessions G`` — G concurrent generations on the
@@ -243,6 +265,7 @@ def run_fused(args, cfg: ModelConfig, params) -> int:
     num_stages = args.num_stages or max(1, min(len(jax.devices()) // args.tp, 4))
     while cfg.num_layers % num_stages:
         num_stages -= 1
+    params = _maybe_quantize(args, params, tp=args.tp)
     if getattr(args, "ring_sessions", 0) > 1:
         return _run_fused_ring(args, cfg, params, num_stages)
     pipe = IciPipeline.build(cfg, params, num_stages=num_stages,
@@ -252,7 +275,31 @@ def run_fused(args, cfg: ModelConfig, params) -> int:
 
     def generate(prompt_ids, max_new_tokens, sampling, eos_token_id=None,
                  **_kw):
+        from .ops.sampling import (
+            make_recent_buffer,
+            push_recent,
+            sample_token_jit,
+            sampling_scalars,
+        )
         from .runtime.client import GenerationResult
+
+        sp_args = sampling_scalars(sampling.temperature, sampling.top_p,
+                                   sampling.top_k,
+                                   sampling.repetition_penalty)
+        recent, nvalid = make_recent_buffer()
+
+        def pick(logits_last, step):
+            # Full reference sampler (jitted — one executable for every
+            # knob config), oracle key schedule PRNGKey(seed + step) —
+            # single-session fused output matches --mode oracle.
+            nonlocal recent, nvalid
+            if sampling.greedy:
+                return int(jnp.argmax(logits_last))
+            tok = sample_token_jit(jax.random.PRNGKey(args.seed + step),
+                                   logits_last.astype(jnp.float32),
+                                   recent, nvalid, *sp_args)
+            recent, nvalid = push_recent(recent, nvalid, tok)
+            return int(tok)
 
         max_len = len(prompt_ids) + max_new_tokens + 1
         kv_dtype = pipe.embed["wte"].dtype
@@ -260,13 +307,13 @@ def run_fused(args, cfg: ModelConfig, params) -> int:
         ids = jnp.asarray(np.asarray(prompt_ids, np.int32)[None, None, :])
         t0 = time.monotonic()
         logits, k, v = pipe.forward(ids, k, v, jnp.int32(0))
-        tok = int(jnp.argmax(logits[0, 0, -1]))
+        tok = pick(logits[0, 0, -1], 0)
         ttft = time.monotonic() - t0
         tokens = [tok]
         cur = len(prompt_ids)
         decode_times = []
         stopped = "max_tokens"
-        for _ in range(max_new_tokens - 1):
+        for step_i in range(1, max_new_tokens):
             if eos_token_id is not None and tokens[-1] == eos_token_id:
                 stopped = "eos"
                 break
@@ -276,14 +323,12 @@ def run_fused(args, cfg: ModelConfig, params) -> int:
             t0 = time.monotonic()
             step = jnp.asarray([[[tokens[-1]]]], jnp.int32)
             logits, k, v = pipe.forward(step, k, v, jnp.int32(cur))
-            tokens.append(int(jnp.argmax(logits[0, 0, -1])))
+            tokens.append(pick(logits[0, 0, -1], step_i))
             decode_times.append(time.monotonic() - t0)
             cur += 1
         return GenerationResult(tokens=tokens, ttft_s=ttft,
                                 decode_times_s=decode_times, stopped_by=stopped)
 
-    if args.temperature > 0:
-        logger.warning("fused mode samples greedily (temperature ignored)")
     return _generate_and_report(args, generate, cfg,
                                 supports_speculative=False)
 
@@ -296,7 +341,9 @@ def run_oracle(args, cfg: ModelConfig, params) -> int:
     stop conditions checked between chunks — the CUDA-graph replay the
     reference's oracle lacks. The sampled path folds the full reference
     sampler into the scan with the SAME per-step key schedule as the old
-    per-token loop, so outputs are bit-identical to it."""
+    per-token loop, so outputs are bit-identical to it. ``--quant`` serves
+    int8/nf4 weights, dequantized per layer inside the scan."""
+    params = _maybe_quantize(args, params)
 
     def _drive_chunks(prompt_ids, max_new_tokens, eos_token_id, *,
                       prefill_first_token, run_chunk, chunk):
@@ -377,14 +424,18 @@ def run_oracle(args, cfg: ModelConfig, params) -> int:
                                  prefill_first_token=prefill_first,
                                  run_chunk=run_chunk, chunk=chunk)
 
-        from .ops.sampling import make_recent_buffer, push_recent, sample_token
+        from .ops.sampling import (
+            make_recent_buffer,
+            push_recent,
+            sample_token_jit,
+            sampling_scalars,
+        )
         from .runtime.fused_decode import make_fused_sample_decode
 
         fn = make_fused_sample_decode(cfg, chunk)
-        sp_args = (jnp.asarray(sampling.temperature, jnp.float32),
-                   jnp.asarray(sampling.top_p, jnp.float32),
-                   jnp.asarray(sampling.top_k, jnp.int32),
-                   jnp.asarray(sampling.repetition_penalty, jnp.float32))
+        sp_args = sampling_scalars(sampling.temperature, sampling.top_p,
+                                   sampling.top_k,
+                                   sampling.repetition_penalty)
         state = {"recent": None, "nvalid": None}
 
         def prefill_first(ids, kc, vc):
@@ -392,8 +443,8 @@ def run_oracle(args, cfg: ModelConfig, params) -> int:
                                           jnp.int32(0))
             recent, nvalid = make_recent_buffer()
             # First token: key schedule step 0 (same as the per-token loop).
-            tok = sample_token(jax.random.PRNGKey(args.seed), logits[0, -1],
-                               recent, nvalid, *sp_args)
+            tok = sample_token_jit(jax.random.PRNGKey(args.seed),
+                                   logits[0, -1], recent, nvalid, *sp_args)
             state["recent"], state["nvalid"] = push_recent(recent, nvalid,
                                                            tok)
             return int(tok), kc, vc
@@ -477,12 +528,15 @@ def _run_fused_ring(args, cfg: ModelConfig, params, num_stages: int) -> int:
                + max(chunk, spec_k + 1))
     k, v = pipe.init_kv(1, max(128, max_len), dtype=pipe.embed["wte"].dtype)
 
-    from .ops.sampling import RECENT_WINDOW, push_recent, sample_token
+    from .ops.sampling import (
+        RECENT_WINDOW,
+        push_recent,
+        sample_token_jit,
+        sampling_scalars,
+    )
 
-    sp_scalars = (jnp.asarray(args.temperature, jnp.float32),
-                  jnp.asarray(args.top_p, jnp.float32),
-                  jnp.asarray(args.top_k, jnp.int32),
-                  jnp.asarray(args.repetition_penalty, jnp.float32))
+    sp_scalars = sampling_scalars(args.temperature, args.top_p, args.top_k,
+                                  args.repetition_penalty)
     recent = jnp.zeros((G, 1, RECENT_WINDOW), jnp.int32)
     nvalid = jnp.zeros((G, 1), jnp.int32)
 
@@ -493,9 +547,9 @@ def _run_fused_ring(args, cfg: ModelConfig, params, num_stages: int) -> int:
         first, k, v = prefill_one(jnp.asarray([ids_g], jnp.int32), k, v, g)
         if sampled:
             # Key-schedule step 0 on the prefill logits (run_oracle parity).
-            tok = sample_token(jax.random.PRNGKey(args.seed),
-                               first[0], recent[g, 0], nvalid[g, 0],
-                               *sp_scalars)
+            tok = sample_token_jit(jax.random.PRNGKey(args.seed),
+                                   first[0], recent[g, 0], nvalid[g, 0],
+                                   *sp_scalars)
             r2, n2 = push_recent(recent[g, 0], nvalid[g, 0], tok)
             recent = recent.at[g, 0].set(r2)
             nvalid = nvalid.at[g, 0].set(n2)
@@ -678,11 +732,9 @@ def _stage_params(args, cfg: ModelConfig, params, spec):
                                        dtype=_DTYPE_MAP[args.dtype])
     else:
         sp = slice_stage_params(cfg, params, spec)
-    if getattr(args, "quant", "none") != "none":
-        from .models.quant import quantize_params
-
-        sp = quantize_params(sp, args.quant)
-    return sp
+    # Stage-server TP + quant is guarded downstream (the TP engine's shard
+    # tables reject quantized leaves loudly), so no tp check here.
+    return _maybe_quantize(args, sp)
 
 
 def run_registry(args, cfg: ModelConfig, params) -> int:
@@ -1019,9 +1071,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", choices=["float32", "bfloat16", "float16"],
                    default="float32")
     p.add_argument("--quant", choices=["none", "int8", "nf4"], default="none",
-                   help="weight-only block quantization on stage servers "
-                        "(reference V9 surface: int8 per-channel, nf4 "
-                        "4-bit NormalFloat at 4.25 bits/param)")
+                   help="weight-only block quantization (reference V9 "
+                        "surface: int8 per-channel, nf4 4-bit NormalFloat "
+                        "at 4.25 bits/param) — stage servers AND the "
+                        "fused/ring/oracle engines. int8 measured +26% "
+                        "decode tokens/s on a v5e; nf4 is the capacity "
+                        "mode (docs/PERFORMANCE.md)")
     p.add_argument("--prompt", default="Hello, my name is")
     p.add_argument("--max_new_tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.7)

@@ -32,6 +32,15 @@ import jax.numpy as jnp
 RECENT_WINDOW = 50  # reference: generated_tokens[-50:]
 
 
+def sampling_scalars(temperature, top_p, top_k, repetition_penalty):
+    """The traced-scalar 4-tuple every engine passes to `sample_token` —
+    one constructor so the knob order can never skew between call sites."""
+    return (jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_p, jnp.float32),
+            jnp.asarray(top_k, jnp.int32),
+            jnp.asarray(repetition_penalty, jnp.float32))
+
+
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
     """Per-session sampling config; travels in request metadata like the
@@ -300,3 +309,9 @@ def sample_token(
     sampled = jax.random.categorical(rng, jnp.log(jnp.maximum(probs, 1e-20)))
     greedy = jnp.argmax(logits, axis=-1)
     return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+# Jitted entry for HOST-LOOP callers (per-token CLI paths): one compiled
+# executable serves every sampling config (all knobs are traced scalars).
+# In-scan engines trace `sample_token` directly inside their own jits.
+sample_token_jit = jax.jit(sample_token)

@@ -51,7 +51,7 @@ SHARDS = [
     # note, utils.platform.engine_donation).
     ["test_batch_sampling.py", "test_batching.py", "test_beam_search.py"],
     ["test_checkpoint_streaming.py", "test_chunked_prefill.py",
-     "test_chunked_wire.py", "test_cli.py"],
+     "test_chunked_wire.py", "test_cli.py", "test_paged_attention.py"],
     # 2: distributed bring-up + elastic serving
     ["test_dcn.py", "test_elastic_server.py", "test_finetune.py",
      "test_fused_decode.py", "test_ici_pipeline.py", "test_kv_cache.py",
@@ -68,7 +68,8 @@ SHARDS = [
      "test_serve_sp.py", "test_serve_tp.py", "test_sp_stage.py"],
     # 6: speculative + swarm + parallel math
     ["test_speculative.py", "test_swarm_launcher.py", "test_task_pool.py",
-     "test_tensor_parallel.py", "test_throughput.py", "test_trainer.py"],
+     "test_tensor_parallel.py", "test_throughput.py", "test_trainer.py",
+     "test_deep_prompts.py"],
 ]
 
 

@@ -77,6 +77,33 @@ def test_ring_sessions_cli_matches_single_session_fused(capsys):
         f"{ring_texts} vs {singles}")
 
 
+def test_quantized_fused_matches_quantized_oracle(capsys):
+    """--quant int8 serves through the fused pipeline AND the oracle with
+    identical quantization, so their greedy outputs must agree (the
+    int8-vs-full-precision delta is the model's business; the engines'
+    parity is ours)."""
+    common = ["--model", "gpt2", "--max_new_tokens", "5",
+              "--temperature", "0", "--prompt", "hi", "--quant", "int8"]
+    rc = main(["--mode", "oracle"] + common)
+    assert rc == 0 or rc is None
+    oracle_text = capsys.readouterr().out.split("===")[2].splitlines()[1]
+
+    rc = main(["--mode", "fused", "--num_stages", "2"] + common)
+    assert rc == 0 or rc is None
+    fused_text = capsys.readouterr().out.split("===")[2].splitlines()[1]
+    assert fused_text == oracle_text
+
+
+def test_quant_with_tp_rejected_on_fused_path():
+    """--quant x --tp would silently replicate quantized leaves over the
+    tp axis (the psum then scales every projection by tp) — must refuse
+    loudly, mirroring the TP stage engine's own guard."""
+    with pytest.raises(SystemExit, match="quant.*tp"):
+        main(["--mode", "fused", "--num_stages", "2", "--tp", "2",
+              "--quant", "int8", "--model", "gpt2", "--prompt", "hi",
+              "--max_new_tokens", "2", "--temperature", "0"])
+
+
 def test_ring_sessions_speculative_cli_matches_plain_ring(capsys):
     """--ring_sessions x --speculative_k compose: drafted tokens ride the
     rotation and greedy output is token-identical to the non-speculative
@@ -96,6 +123,23 @@ def test_ring_sessions_speculative_cli_matches_plain_ring(capsys):
     assert spec == plain, (
         f"speculative ring diverged from plain ring: {spec} vs {plain}")
     assert "Speculative:" in out and "rounds" in out
+
+
+@pytest.mark.parity
+def test_fused_sampled_cli_matches_oracle(capsys):
+    """Single-session --mode fused with temperature > 0 runs the full
+    sampler on the pipeline's logits with the oracle key schedule —
+    text equals --mode oracle at the same seed."""
+    common = ["--model", "gpt2", "--max_new_tokens", "5", "--prompt", "hi",
+              "--temperature", "0.8", "--top_p", "0.9", "--top_k", "20",
+              "--repetition_penalty", "1.3", "--seed", "29"]
+    rc = main(["--mode", "oracle"] + common)
+    assert rc == 0 or rc is None
+    oracle_text = capsys.readouterr().out.split("===")[2].splitlines()[1]
+    rc = main(["--mode", "fused", "--num_stages", "2"] + common)
+    assert rc == 0 or rc is None
+    fused_text = capsys.readouterr().out.split("===")[2].splitlines()[1]
+    assert fused_text == oracle_text
 
 
 @pytest.mark.parity
