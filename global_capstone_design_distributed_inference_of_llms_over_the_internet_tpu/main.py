@@ -791,6 +791,9 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
 
     registry = RemoteRegistry(args.registry_addr)
     peer_id = args.peer_id or f"stage{args.stage}-{os.getpid()}"
+    if args.sp_zigzag and args.sp <= 1:
+        raise SystemExit("--sp_zigzag requires --sp N > 1 (it is a layout "
+                         "for the sequence-parallel engine)")
     if args.sp > 1 and (args.batched or args.tp > 1 or args.use_cpu_offload):
         raise SystemExit("--sp does not compose with --batched/--tp/"
                          "--use_cpu_offload on one server")
@@ -809,7 +812,8 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
         mesh = _Mesh(np.asarray(devs[:args.sp]), ("sp",))
         runner = SpStageRunner(cfg, spec,
                                _stage_params(args, cfg, params, spec), mesh,
-                               dtype=_DTYPE_MAP[args.dtype])
+                               dtype=_DTYPE_MAP[args.dtype],
+                               zigzag=args.sp_zigzag)
         # max_context default (8192/chip + tail) is the ADAPTER's policy.
         ex = SpStageAdapter(runner, peer_id=peer_id,
                             max_context=args.max_context)
@@ -1141,6 +1145,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "local ('sp',) mesh of N chips, so prompts beyond "
                         "one device's KV budget serve end-to-end; "
                         "advertised as engine=sp with --max_context")
+    p.add_argument("--sp_zigzag", action="store_true",
+                   help="serve --sp: zigzag sequence layout — each device "
+                        "holds one early + one late half-chunk, flattening "
+                        "causal-prefill work across the mesh (critical "
+                        "path ~halves at sp=8); token-identical output")
     p.add_argument("--max_context", type=int, default=None,
                    help="serve --sp: advertised admission limit "
                         "(prompt+generated tokens); default 8192 per chip")

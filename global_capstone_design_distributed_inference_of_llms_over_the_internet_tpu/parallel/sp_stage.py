@@ -48,7 +48,17 @@ from .ring_attention import (
     online_combine,
     online_partial,
     ring_attention,
+    zigzag_order,
+    zigzag_ring_attention,
 )
+
+
+def _zigzag_device_positions(idx, c, p):
+    """Absolute sequence positions of device ``idx``'s zigzag chunk of
+    size c (= two half-chunks of c//2: low chunk idx, high 2P-1-idx)."""
+    c2 = c // 2
+    ar = jnp.arange(c2, dtype=jnp.int32)
+    return jnp.concatenate([idx * c2 + ar, (2 * p - 1 - idx) * c2 + ar])
 
 Params = Dict[str, Any]
 
@@ -93,6 +103,7 @@ class SpStageRunner:
         *,
         tail_max: int = 512,
         dtype=jnp.float32,
+        zigzag: bool = False,
     ):
         if cfg.sliding_window:
             raise ValueError("sp serving is causal-only (no sliding window)")
@@ -103,6 +114,15 @@ class SpStageRunner:
         self.p = int(mesh.shape[axis_name])
         self.tail_max = tail_max
         self.dtype = jnp.dtype(dtype)
+        # Zigzag sequence layout (parallel.ring_attention zigzag): device i
+        # holds half-chunks i and 2P-1-i, so causal-prefill work is FLAT
+        # across devices ((2P+1)/4 block-equivalents each) instead of
+        # skewed 1..P — the slowest device's critical path roughly halves.
+        # The session's prefix KV then LIVES in zigzag order; decode is
+        # layout-agnostic (its per-device softmax partial only needs the
+        # right position array) and returned hiddens are restored to
+        # natural order, so the flag is invisible outside this class.
+        self.zigzag = zigzag
         # Engine-side fused-QKV layout (one projection matmul per layer,
         # bitwise-identical — models/transformer.fuse_qkv_params); the sp
         # axis shards the SEQUENCE, never the projections, so fusion is
@@ -150,8 +170,11 @@ class SpStageRunner:
 
     def prefix_bytes_per_device(self, t: int, batch: int = 1) -> int:
         """Per-device bytes of a session's sharded prefix KV for a t-token
-        prompt (k + v, padded to the mesh)."""
-        t_pad = -(-t // self.p) * self.p
+        prompt (k + v, padded to the mesh — 2P-aligned under zigzag, the
+        same rounding start_session applies, or admission control would
+        undercount the real allocation and overcommit HBM)."""
+        mult = 2 * self.p if self.zigzag else self.p
+        t_pad = -(-t // mult) * mult
         l = max(self.spec.num_layers, 1)
         return (2 * l * batch * (t_pad // self.p) * self.cfg.num_kv_heads
                 * self.cfg.head_dim * self.dtype.itemsize)
@@ -184,15 +207,23 @@ class SpStageRunner:
                     P(None, None, axis),                   # k [L,B,C,...]
                     P(None, None, axis))                   # v
 
+        zigzag = self.zigzag
+
         @jax.jit
         @partial(jax.shard_map, mesh=mesh, in_specs=in_spec,
                  out_specs=out_spec)
         def fn(params, x):
             idx = jax.lax.axis_index(axis)
+            p = jax.lax.psum(1, axis)
             c = x.shape[1]
             b = x.shape[0]
-            positions = jnp.broadcast_to(
-                idx * c + jnp.arange(c, dtype=jnp.int32)[None, :], (b, c))
+            if zigzag:
+                # x arrives PRE-PERMUTED to zigzag order (start_session);
+                # this device holds half-chunks idx and 2P-1-idx.
+                pos_dev = _zigzag_device_positions(idx, c, p)
+            else:
+                pos_dev = idx * c + jnp.arange(c, dtype=jnp.int32)
+            positions = jnp.broadcast_to(pos_dev[None, :], (b, c))
             if spec.is_first:
                 h = embed_tokens(cfg, params["embed"], x, positions)
             else:
@@ -208,7 +239,10 @@ class SpStageRunner:
                 if rope is not None:
                     q = apply_rope(q, *rope)
                     k = apply_rope(k, *rope)
-                out = ring_attention(q, k, v, axis, q_offset=idx * c)
+                if zigzag:
+                    out = zigzag_ring_attention(q, k, v, axis)
+                else:
+                    out = ring_attention(q, k, v, axis, q_offset=idx * c)
                 out = out.reshape(h.shape[0], c, -1) @ lp["attn"]["wo"]
                 if "bo" in lp["attn"]:
                     out = out + lp["attn"]["bo"]
@@ -231,10 +265,14 @@ class SpStageRunner:
         hidden is global, sequence-sharded, padded rows trimmed."""
         x = jnp.asarray(x)
         b, t = x.shape[0], x.shape[1]
-        t_pad = -(-t // self.p) * self.p
+        # Zigzag needs an even half-chunk split per device (2 per device).
+        mult = 2 * self.p if self.zigzag else self.p
+        t_pad = -(-t // mult) * mult
         if t_pad != t:
             padw = ((0, 0), (0, t_pad - t)) + (((0, 0),) if x.ndim == 3 else ())
             x = jnp.pad(x, padw)
+        if self.zigzag:
+            x = jnp.take(x, zigzag_order(t_pad, self.p), axis=1)
         x = jax.device_put(
             x, NamedSharding(self.mesh,
                              P(None, self.axis) if x.ndim == 2
@@ -243,6 +281,10 @@ class SpStageRunner:
             self._prefill_fn = self._build_prefill()
         sess = SpSession()
         h, sess.pk, sess.pv = self._prefill_fn(self.params, x)
+        if self.zigzag:
+            # Callers see natural order; only the SESSION's prefix KV stays
+            # zigzag-resident (decode is layout-agnostic given positions).
+            h = jnp.take(h, jnp.argsort(zigzag_order(t_pad, self.p)), axis=1)
         sess.prefix_pad = t_pad
         sess.prefix_len = t
         sess.tail_len = 0
@@ -273,6 +315,8 @@ class SpStageRunner:
                    P(), P(), P())                           # prefix_len, tail_len, pos
         out_spec = (P(), P(), P())                          # h, tail k, tail v
 
+        zigzag = self.zigzag
+
         # Donate the tail caches (updated every step) so the append is
         # in-place; the prefix caches are NOT donated — the same buffers are
         # re-passed for the whole session.
@@ -281,6 +325,7 @@ class SpStageRunner:
                  out_specs=out_spec)
         def fn(params, x, pk, pv, tk, tv, prefix_len, tail_len, pos):
             idx = jax.lax.axis_index(axis)
+            p_dev = jax.lax.psum(1, axis)
             b = x.shape[0]
             positions = jnp.full((b, 1), pos, jnp.int32)
             if spec.is_first:
@@ -309,8 +354,15 @@ class SpStageRunner:
                     tv_l, v.astype(tv_l.dtype), tail_len, axis=1)
 
                 qg = q.reshape(b, 1, cfg.num_kv_heads, groups, cfg.head_dim)
-                # Partial over MY prefix shard (positions idx*c + j).
-                ppos = idx * c + jnp.arange(c, dtype=jnp.int32)
+                # Partial over MY prefix shard. The prefix KV lives in the
+                # layout prefill produced — contiguous (positions idx*c+j)
+                # or zigzag (two half-chunks); the online-softmax partial
+                # only needs the matching position array, the psum combine
+                # is order-independent.
+                if zigzag:
+                    ppos = _zigzag_device_positions(idx, c, p_dev)
+                else:
+                    ppos = idx * c + jnp.arange(c, dtype=jnp.int32)
                 pmask = jnp.broadcast_to((ppos < prefix_len)[None, :], (b, c))
                 part = online_partial(qg, pk_l.astype(q.dtype),
                                       pv_l.astype(q.dtype), pmask, scale)

@@ -184,3 +184,27 @@ def test_sp_rejects_sliding_window():
         assert "sliding" in str(exc)
     else:
         raise AssertionError("sliding-window config must be rejected")
+
+
+def test_sp_zigzag_layout_matches_oracle():
+    """zigzag=True is a pure WORK-BALANCE change (device i holds one early
+    + one late half-chunk; the prefix KV lives zigzag-resident): tokens
+    must match the oracle exactly — aligned, unaligned, and across the
+    prefill/decode boundary."""
+    cfg = tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    runner = SpStageRunner(cfg, full_spec(cfg), params, sp_mesh(),
+                           zigzag=True)
+    # T=24: pads to 32 (multiple of 2P=16), zigzag half-chunks of 2.
+    prompt = [5, 9, 23, 7, 81, 2, 14, 3, 19, 44, 6, 77, 8, 1, 90, 33,
+              12, 4, 56, 21, 9, 100, 41, 2]
+    ref = oracle_tokens(cfg, params, prompt, 6)
+    got = sp_generate(runner, prompt, 6)
+    assert got == ref
+    # Unaligned (T=13) exercises the 2P padding path.
+    prompt2 = list(range(3, 16))
+    ref2 = oracle_tokens(cfg, params, prompt2, 5)
+    runner2 = SpStageRunner(cfg, full_spec(cfg), params, sp_mesh(),
+                            zigzag=True)
+    got2 = sp_generate(runner2, prompt2, 5)
+    assert got2 == ref2
