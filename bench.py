@@ -1209,7 +1209,9 @@ def _compact_summary(results, primary, vs):
             per_config[name] = row["work_ratio_measured"]
         elif "tick_ms" in row:
             per_config[name] = row["tick_ms"]
-        elif "intercept_ratio" in row:   # interleaved-trainer row
+        elif "spec_ticks_per_token_full_accept" in row:  # ring x spec row
+            per_config[name] = row["spec_ticks_per_token_full_accept"]
+        elif row.get("intercept_ratio") is not None:  # interleaved trainer
             per_config[name] = row["intercept_ratio"]
         else:
             per_config[name] = "see-full-record"
