@@ -61,14 +61,15 @@ def _lut16(codes: jnp.ndarray, table) -> jnp.ndarray:
     b1 = (codes & 2).astype(bool)
     b2 = (codes & 4).astype(bool)
     b3 = (codes & 8).astype(bool)
-    # bf16 intermediates: the tree is VPU-bandwidth-bound, and the 16
-    # level constants round-trip bf16 with < 0.4% error — far under the
-    # 4-bit quantization error itself. The consumer upcasts as needed.
-    lvl = [jnp.bfloat16(t) for t in table]
+    # f32 levels: measured identical speed to bf16 intermediates (the tree
+    # is op-bound, not width-bound), and f32 keeps the dequant VALUES
+    # identical to the fused kernel's (ops.nf4_kernel), so the two paths
+    # differ only by matmul accumulation order.
+    lvl = [jnp.float32(t) for t in table]
     l1 = [jnp.where(b0, lvl[2 * i + 1], lvl[2 * i]) for i in range(8)]
     l2 = [jnp.where(b1, l1[2 * i + 1], l1[2 * i]) for i in range(4)]
     l3 = [jnp.where(b2, l2[2 * i + 1], l2[2 * i]) for i in range(2)]
-    return jnp.where(b3, l3[1], l3[0]).astype(jnp.float32)
+    return jnp.where(b3, l3[1], l3[0])
 
 
 @jax.tree_util.register_pytree_node_class
@@ -238,16 +239,36 @@ def quantize_params(params: Params, quant: str = "int8") -> Params:
 _QUANT_TYPES = (QuantizedTensor, NF4Tensor)
 
 
+def nf4_kernel_enabled() -> bool:
+    """NF4_KERNEL=1 routes per-layer NF4 matmuls through the fused Pallas
+    dequant-matmul kernel (ops.nf4_kernel) instead of materializing the
+    weight — the measured lever for nf4 decode throughput. Default OFF."""
+    import os
+
+    return os.environ.get("NF4_KERNEL", "0") == "1"
+
+
 def dequant_tree(tree: Params) -> Params:
     """Materialize full-precision weights for any quantized leaves (int8 or
     NF4). Identity (and free) for unquantized trees; under jit+scan this
     runs per layer, so only one layer's weights exist dequantized at a
-    time."""
+    time.
+
+    With `nf4_kernel_enabled()`, per-layer (2-D) NF4 leaves stay packed —
+    the matmul sites (`models.transformer._dot`) feed them to the fused
+    kernel; stacked/expert (3-D) NF4 leaves still materialize (the MoE
+    einsums have no kernel path)."""
+    keep_nf4 = nf4_kernel_enabled()
+
+    def f(x):
+        if not isinstance(x, _QUANT_TYPES):
+            return x
+        if keep_nf4 and isinstance(x, NF4Tensor) and x.packed.ndim == 2:
+            return x
+        return x.dequant()
+
     return jax.tree.map(
-        lambda x: x.dequant() if isinstance(x, _QUANT_TYPES) else x,
-        tree,
-        is_leaf=lambda x: isinstance(x, _QUANT_TYPES),
-    )
+        f, tree, is_leaf=lambda x: isinstance(x, _QUANT_TYPES))
 
 
 def is_quantized(tree: Params) -> bool:

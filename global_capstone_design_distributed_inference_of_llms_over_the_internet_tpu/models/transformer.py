@@ -143,6 +143,20 @@ def _psum_if(x: jnp.ndarray, tp_axis: Optional[str]) -> jnp.ndarray:
     return jax.lax.psum(x, tp_axis) if tp_axis is not None else x
 
 
+def _dot(x: jnp.ndarray, w) -> jnp.ndarray:
+    """Weight matmul with NF4-kernel dispatch: a packed NF4Tensor leaf
+    (left intact by dequant_tree under NF4_KERNEL=1) runs the fused Pallas
+    dequant-matmul (ops.nf4_kernel); plain arrays take the ordinary
+    matmul. One helper so every projection site dispatches identically."""
+    from .quant import NF4Tensor
+
+    if isinstance(w, NF4Tensor):
+        from ..ops.nf4_kernel import nf4_dot
+
+        return nf4_dot(x, w)
+    return x @ w
+
+
 def qkv_proj(cfg: ModelConfig, p: Params, x: jnp.ndarray):
     """Attention projections (+ optional q/k/v biases), reshaped to heads.
     x: [B, T, D] -> q [B, T, H, Dh], k/v [B, T, Hkv, Dh]. The ONE place the
@@ -160,7 +174,7 @@ def qkv_proj(cfg: ModelConfig, p: Params, x: jnp.ndarray):
     b, t, _ = x.shape
     dh = cfg.head_dim
     if "wqkv" in p:
-        qkv = x @ p["wqkv"]
+        qkv = _dot(x, p["wqkv"])
         w = qkv.shape[-1]
         hd = w * cfg.num_heads // (cfg.num_heads + 2 * cfg.num_kv_heads)
         kd = (w - hd) // 2
@@ -168,9 +182,9 @@ def qkv_proj(cfg: ModelConfig, p: Params, x: jnp.ndarray):
         k = qkv[..., hd:hd + kd]
         v = qkv[..., hd + kd:]
     else:
-        q = x @ p["wq"]
-        k = x @ p["wk"]
-        v = x @ p["wv"]
+        q = _dot(x, p["wq"])
+        k = _dot(x, p["wk"])
+        v = _dot(x, p["wv"])
     if "bq" in p:
         q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
     return (q.reshape(b, t, -1, dh), k.reshape(b, t, -1, dh),
@@ -220,14 +234,14 @@ def _mlp(cfg: ModelConfig, p: Params, x: jnp.ndarray, tp_axis: Optional[str]) ->
     if cfg.is_moe:
         return _moe_mlp(cfg, p, x, tp_axis)
     if cfg.mlp == "swiglu":
-        gate = jax.nn.silu(x @ p["wg"])
-        up = x @ p["wu"]
-        return _psum_if((gate * up) @ p["wd"], tp_axis)
-    y = x @ p["wi"]
+        gate = jax.nn.silu(_dot(x, p["wg"]))
+        up = _dot(x, p["wu"])
+        return _psum_if(_dot(gate * up, p["wd"]), tp_axis)
+    y = _dot(x, p["wi"])
     if "bi" in p:
         y = y + p["bi"]
     y = jax.nn.gelu(y, approximate=True)  # gpt2 uses gelu_new (tanh approx)
-    y = _psum_if(y @ p["wo"], tp_axis)
+    y = _psum_if(_dot(y, p["wo"]), tp_axis)
     if "bo" in p:
         y = y + p["bo"]
     return y
@@ -323,7 +337,7 @@ def _attention(
                 q, k_cache, v_cache, cache_len,
                 sliding_window=cfg.sliding_window
             )
-    y = out.reshape(b, t, h_local * dh) @ p["wo"]
+    y = _dot(out.reshape(b, t, h_local * dh), p["wo"])
     y = _psum_if(y, tp_axis)
     if "bo" in p:
         y = y + p["bo"]

@@ -1,0 +1,146 @@
+"""Fused NF4 dequant-matmul Pallas kernel (the round-5 nf4 throughput
+lever).
+
+XLA cannot fuse the 4-bit unpack + 16-level codebook lookup into the MXU
+operand feed: the dequantized weight materializes through a ~20-op VPU
+elementwise chain per weight per step, measured 5x slower than bf16
+serving on the flagship (docs/PERFORMANCE.md "Quantized serving"). This
+kernel streams the PACKED nibbles (0.5 B/weight) + per-block scales from
+HBM, dequantizes per N-tile in VMEM, and feeds the MXU directly.
+
+Layout trick: a packed byte holds K-rows (2r, 2r+1) — rather than
+interleave rows in VMEM (a sublane shuffle Mosaic lowers badly), the
+matmul is split by nibble parity:
+
+    y = x_even @ dequant(high_nibbles) + x_odd @ dequant(low_nibbles)
+
+which is exact because matmul contraction is order-free. The activation
+is split host-side (x[:, 0::2], x[:, 1::2] — tiny [M, K] tensors).
+
+Grid: one program per 128-wide N tile, full-K stripes (the K loop lives
+in the MXU contraction; no cross-program accumulation state). Tile-size
+gotchas learned on-chip, encoded as guards below: N must split into
+128-lane tiles (a non-dividing grid silently truncates), scales ride as
+f32 so the scale block's sublane count stays legal, and the uint8 block
+is widened to int32 BEFORE shifting (Mosaic cannot legalize vector i8
+shrui).
+
+`nf4_dot` is the dispatch wrapper used by the model's matmul sites when
+`NF4_KERNEL=1` (utils env flag): it falls back to dequant-then-matmul
+for any shape the kernel does not cover, so enabling the flag can never
+change reachability — only speed. Token parity with the dequant path is
+pinned by tests/test_nf4_kernel.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# NOTE on enablement: the NF4_KERNEL env flag is consumed in
+# models.quant.dequant_tree (which decides whether packed NF4 leaves
+# reach the matmul sites at all); nf4_dot itself dispatches purely on
+# leaf type and shape.
+from ..models.quant import NF4_LEVELS, NF4Tensor
+
+TILE_N = 128
+
+# Tests flip this to run the kernel through the Pallas interpreter on the
+# CPU backend (slow, exact semantics) — the kernel itself targets TPU.
+_INTERPRET = False
+
+
+def _lut16_f32(c):
+    """Kernel-side 16-entry select tree in f32 throughout. quant.py's
+    `_lut16` selects bf16 levels from int32-derived bool masks, which
+    Mosaic cannot relayout ((8,128) i1 tiles into (16,128) bf16 wheres —
+    'Invalid relayout ... vector<...xi1>'); keeping every intermediate at
+    32-bit width sidesteps it, and the f32->bf16 cast happens once after
+    the scale multiply."""
+    b0 = (c & 1).astype(bool)
+    b1 = (c & 2).astype(bool)
+    b2 = (c & 4).astype(bool)
+    b3 = (c & 8).astype(bool)
+    lvl = [jnp.float32(t) for t in NF4_LEVELS]
+    l1 = [jnp.where(b0, lvl[2 * i + 1], lvl[2 * i]) for i in range(8)]
+    l2 = [jnp.where(b1, l1[2 * i + 1], l1[2 * i]) for i in range(4)]
+    l3 = [jnp.where(b2, l2[2 * i + 1], l2[2 * i]) for i in range(2)]
+    return jnp.where(b3, l3[1], l3[0])
+
+
+@functools.lru_cache(maxsize=64)
+def _make_kernel(m: int, k: int, n: int, out_dtype: str,
+                 interpret: bool = False):
+    from jax.experimental import pallas as pl
+
+    p = k // 2
+    sb = k // 64
+
+    def kernel(xe_ref, xo_ref, pk_ref, sc_ref, out_ref):
+        packed = pk_ref[:].astype(jnp.int32)   # int32 FIRST: Mosaic has no
+        hi = (packed >> 4) & 0xF               # vector i8 shrui
+        lo = packed & 0xF
+        scale = jnp.repeat(sc_ref[:], p // sb, axis=0)      # [P, TILE_N]
+        # Weights take the ACTIVATION dtype (bf16 serving feeds the MXU at
+        # bf16 rate; an f32 activation keeps f32 — also what the CPU
+        # interpreter's dot supports).
+        wdt = xe_ref.dtype
+        wh = (_lut16_f32(hi) * scale).astype(wdt)
+        wl = (_lut16_f32(lo) * scale).astype(wdt)
+        acc = jnp.dot(xe_ref[:], wh, preferred_element_type=jnp.float32)
+        acc = acc + jnp.dot(xo_ref[:], wl,
+                            preferred_element_type=jnp.float32)
+        out_ref[:] = acc.astype(out_ref.dtype)
+
+    @jax.jit
+    def fn(xe, xo, packed, scales):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((m, n), jnp.dtype(out_dtype)),
+            grid=(n // TILE_N,),
+            in_specs=[
+                pl.BlockSpec((m, p), lambda j: (0, 0)),
+                pl.BlockSpec((m, p), lambda j: (0, 0)),
+                pl.BlockSpec((p, TILE_N), lambda j: (0, j)),
+                pl.BlockSpec((sb, TILE_N), lambda j: (0, j)),
+            ],
+            out_specs=pl.BlockSpec((m, TILE_N), lambda j: (0, j)),
+            interpret=interpret,
+        )(xe, xo, packed, scales)
+
+    return fn
+
+
+def _supported(m: int, w: NF4Tensor) -> bool:
+    in_dim = w.in_dim
+    n = w.packed.shape[-1]
+    assert m % 8 == 0, "caller pads rows to a multiple of 8"
+    return (w.packed.ndim == 2            # one layer's weight, not a stack
+            and in_dim == w.packed.shape[0] * 2   # no in-axis padding
+            and in_dim % 128 == 0
+            and n % TILE_N == 0
+            and (jax.default_backend() == "tpu" or _INTERPRET))
+
+
+def nf4_dot(x: jnp.ndarray, w: NF4Tensor) -> jnp.ndarray:
+    """x [..., K] @ NF4 weight [K, N] -> [..., N] in x.dtype.
+
+    Kernel path when the shape qualifies (see `_supported`); exact
+    dequant-then-matmul fallback otherwise — enabling the kernel never
+    changes which shapes serve."""
+    lead = x.shape[:-1]
+    k = x.shape[-1]
+    x2 = x.reshape(-1, k)
+    m = x2.shape[0]
+    m_pad = -(-max(m, 8) // 8) * 8
+    if _supported(m_pad, w):
+        if m_pad != m:
+            x2 = jnp.pad(x2, ((0, m_pad - m), (0, 0)))
+        fn = _make_kernel(m_pad, k, w.packed.shape[-1], str(x.dtype),
+                          interpret=_INTERPRET)
+        out = fn(x2[:, 0::2], x2[:, 1::2], w.packed,
+                 w.scales.astype(jnp.float32))
+        return out[:m].reshape(*lead, -1)
+    return x @ w.dequant().astype(x.dtype)

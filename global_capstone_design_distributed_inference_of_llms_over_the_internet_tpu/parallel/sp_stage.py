@@ -40,7 +40,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
 from ..models.partition import StageSpec
-from ..models.transformer import _mlp, _norm, embed_tokens, make_rope, qkv_proj
+from ..models.transformer import (
+    _dot,
+    _mlp,
+    _norm,
+    embed_tokens,
+    make_rope,
+    qkv_proj,
+)
 from ..ops.rotary import apply_rope
 from ..utils.platform import engine_donation
 from .ring_attention import (
@@ -243,7 +250,7 @@ class SpStageRunner:
                     out = zigzag_ring_attention(q, k, v, axis)
                 else:
                     out = ring_attention(q, k, v, axis, q_offset=idx * c)
-                out = out.reshape(h.shape[0], c, -1) @ lp["attn"]["wo"]
+                out = _dot(out.reshape(h.shape[0], c, -1), lp["attn"]["wo"])
                 if "bo" in lp["attn"]:
                     out = out + lp["attn"]["bo"]
                 h = h + out
@@ -382,7 +389,7 @@ class SpStageRunner:
                                        tv_n.astype(q.dtype), tmask, scale)
                 m2, l2, o2 = online_combine((mg, lg, og), tpart)
                 out = (o2 / jnp.maximum(l2, 1e-20)[..., None]).astype(h.dtype)
-                out = out.reshape(b, 1, -1) @ lp["attn"]["wo"]
+                out = _dot(out.reshape(b, 1, -1), lp["attn"]["wo"])
                 if "bo" in lp["attn"]:
                     out = out + lp["attn"]["bo"]
                 h = h + out

@@ -41,7 +41,14 @@ import numpy as np
 from ..models.config import ModelConfig
 from ..utils.platform import engine_donation
 from ..models.partition import StageSpec
-from ..models.transformer import _mlp, _norm, embed_tokens, make_rope, qkv_proj
+from ..models.transformer import (
+    _dot,
+    _mlp,
+    _norm,
+    embed_tokens,
+    make_rope,
+    qkv_proj,
+)
 from ..ops.rotary import apply_rope
 from ..parallel.ring_attention import NEG_INF
 from .kv_cache import round_to_bucket
@@ -173,7 +180,7 @@ class BatchedStageExecutor:
                 probs = jax.nn.softmax(scores, axis=-1)
                 out = jnp.einsum("bhgts,bshd->bthgd",
                                  probs.astype(v.dtype), v)
-                out = out.reshape(b, t, -1) @ lp["attn"]["wo"]
+                out = _dot(out.reshape(b, t, -1), lp["attn"]["wo"])
                 if "bo" in lp["attn"]:
                     out = out + lp["attn"]["bo"]
                 h = h + out
@@ -308,7 +315,7 @@ class BatchedStageExecutor:
                 out = jnp.einsum("bhgts,bshd->bthgd",
                                  probs.astype(v_l.dtype),
                                  v_l.astype(q.dtype))
-                out = out.reshape(S, T, -1) @ lp["attn"]["wo"]
+                out = _dot(out.reshape(S, T, -1), lp["attn"]["wo"])
                 if "bo" in lp["attn"]:
                     out = out + lp["attn"]["bo"]
                 h = h + out
