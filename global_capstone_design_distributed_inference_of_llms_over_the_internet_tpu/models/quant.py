@@ -61,10 +61,12 @@ def _lut16(codes: jnp.ndarray, table) -> jnp.ndarray:
     b1 = (codes & 2).astype(bool)
     b2 = (codes & 4).astype(bool)
     b3 = (codes & 8).astype(bool)
-    # f32 levels: measured identical speed to bf16 intermediates (the tree
-    # is op-bound, not width-bound), and f32 keeps the dequant VALUES
-    # identical to the fused kernel's (ops.nf4_kernel), so the two paths
-    # differ only by matmul accumulation order.
+    # f32 levels — LOAD-BEARING for the fused kernel (ops.nf4_kernel runs
+    # THIS function inside Mosaic, which cannot relayout int32-derived
+    # bool masks into bf16-tiled selects), measured identical speed to
+    # bf16 intermediates on the XLA path (op-bound, not width-bound), and
+    # keeps both paths' dequant VALUES identical so they differ only by
+    # matmul accumulation order.
     lvl = [jnp.float32(t) for t in table]
     l1 = [jnp.where(b0, lvl[2 * i + 1], lvl[2 * i]) for i in range(8)]
     l2 = [jnp.where(b1, l1[2 * i + 1], l1[2 * i]) for i in range(4)]
@@ -202,7 +204,7 @@ def _quantize_leaf(w: jnp.ndarray) -> QuantizedTensor:
 # The matmul weight names of models/transformer.py's layer schema. Norms,
 # biases, and the MoE "router" are deliberately absent (full precision).
 _MATMUL_KEYS = frozenset(
-    {"wq", "wk", "wv", "wqkv", "wo", "wg", "wu", "wd", "wi"})
+    {"wq", "wk", "wv", "wqkv", "wo", "wg", "wu", "wgu", "wd", "wi"})
 
 
 def quantize_layers(layers: Params, quant: str = "int8") -> Params:

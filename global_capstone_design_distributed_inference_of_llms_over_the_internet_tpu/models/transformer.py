@@ -214,17 +214,41 @@ def fuse_qkv_layers(layers: Params) -> Params:
     return out
 
 
-def fuse_qkv_params(params: Params) -> Params:
-    """Engine-construction wrapper over `fuse_qkv_layers` for a whole param
-    tree (the one place the guard lives — five engines apply it).
+def fuse_gate_up_layers(layers: Params) -> Params:
+    """Return `layers` with the swiglu wg|wu concatenated into one ``wgu``
+    leaf (output axis) — the MLP analogue of `fuse_qkv_layers`: two
+    output-adjacent GEMMs sharing the same input become ONE matmul with
+    one long weight stream. Bitwise identical (concat along N never
+    changes a column's K-reduction). Same engine-side-only contract and
+    guards as the QKV fusion."""
+    if not isinstance(layers, dict) or "mlp" not in layers:
+        return layers
+    mlp = layers["mlp"]
+    if "wg" not in mlp or "wu" not in mlp:
+        return layers
+    if not all(isinstance(mlp[k], jax.Array) for k in ("wg", "wu")):
+        return layers
+    if "router" in mlp:              # MoE expert weights keep canonical
+        return layers
+    fused = {k: v for k, v in mlp.items() if k not in ("wg", "wu")}
+    fused["wgu"] = jnp.concatenate([mlp["wg"], mlp["wu"]], axis=-1)
+    out = dict(layers)
+    out["mlp"] = fused
+    return out
 
-    Memory note: the fused leaf is a COPY; if the caller keeps its canonical
-    tree alive (e.g. one checkpoint feeding several engines), both layouts
-    stay resident — drop the caller-side reference after construction when
-    projection-weight residency matters."""
+
+def fuse_qkv_params(params: Params) -> Params:
+    """Engine-construction wrapper over `fuse_qkv_layers` +
+    `fuse_gate_up_layers` for a whole param tree (the one place the guard
+    lives — five engines apply it).
+
+    Memory note: the fused leaves are COPIES; if the caller keeps its
+    canonical tree alive (e.g. one checkpoint feeding several engines),
+    both layouts stay resident — drop the caller-side reference after
+    construction when projection-weight residency matters."""
     if not isinstance(params, dict) or "layers" not in params:
         return params
-    fused = fuse_qkv_layers(params["layers"])
+    fused = fuse_gate_up_layers(fuse_qkv_layers(params["layers"]))
     if fused is params["layers"]:
         return params
     return dict(params, layers=fused)
@@ -234,8 +258,14 @@ def _mlp(cfg: ModelConfig, p: Params, x: jnp.ndarray, tp_axis: Optional[str]) ->
     if cfg.is_moe:
         return _moe_mlp(cfg, p, x, tp_axis)
     if cfg.mlp == "swiglu":
-        gate = jax.nn.silu(_dot(x, p["wg"]))
-        up = _dot(x, p["wu"])
+        if "wgu" in p:               # engine-fused layout (fuse_gate_up)
+            gu = _dot(x, p["wgu"])
+            i = gu.shape[-1] // 2
+            gate = jax.nn.silu(gu[..., :i])
+            up = gu[..., i:]
+        else:
+            gate = jax.nn.silu(_dot(x, p["wg"]))
+            up = _dot(x, p["wu"])
         return _psum_if(_dot(gate * up, p["wd"]), tp_axis)
     y = _dot(x, p["wi"])
     if "bi" in p:

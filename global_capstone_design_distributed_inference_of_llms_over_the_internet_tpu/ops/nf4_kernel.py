@@ -43,7 +43,7 @@ import jax.numpy as jnp
 # models.quant.dequant_tree (which decides whether packed NF4 leaves
 # reach the matmul sites at all); nf4_dot itself dispatches purely on
 # leaf type and shape.
-from ..models.quant import NF4_LEVELS, NF4Tensor
+from ..models.quant import NF4_LEVELS, NF4Tensor, _lut16
 
 TILE_N = 128
 
@@ -51,23 +51,11 @@ TILE_N = 128
 # CPU backend (slow, exact semantics) — the kernel itself targets TPU.
 _INTERPRET = False
 
-
-def _lut16_f32(c):
-    """Kernel-side 16-entry select tree in f32 throughout. quant.py's
-    `_lut16` selects bf16 levels from int32-derived bool masks, which
-    Mosaic cannot relayout ((8,128) i1 tiles into (16,128) bf16 wheres —
-    'Invalid relayout ... vector<...xi1>'); keeping every intermediate at
-    32-bit width sidesteps it, and the f32->bf16 cast happens once after
-    the scale multiply."""
-    b0 = (c & 1).astype(bool)
-    b1 = (c & 2).astype(bool)
-    b2 = (c & 4).astype(bool)
-    b3 = (c & 8).astype(bool)
-    lvl = [jnp.float32(t) for t in NF4_LEVELS]
-    l1 = [jnp.where(b0, lvl[2 * i + 1], lvl[2 * i]) for i in range(8)]
-    l2 = [jnp.where(b1, l1[2 * i + 1], l1[2 * i]) for i in range(4)]
-    l3 = [jnp.where(b2, l2[2 * i + 1], l2[2 * i]) for i in range(2)]
-    return jnp.where(b3, l3[1], l3[0])
+# MOSAIC CONSTRAINT on quant._lut16 (one shared select tree): the level
+# constants must stay f32 — bf16 levels would make Mosaic relayout the
+# int32-derived (8,128) i1 mask tiles into (16,128) bf16 selects, which
+# it cannot ('Invalid relayout ... vector<...xi1>'). quant.py documents
+# the same requirement from its side.
 
 
 @functools.lru_cache(maxsize=64)
@@ -87,8 +75,8 @@ def _make_kernel(m: int, k: int, n: int, out_dtype: str,
         # bf16 rate; an f32 activation keeps f32 — also what the CPU
         # interpreter's dot supports).
         wdt = xe_ref.dtype
-        wh = (_lut16_f32(hi) * scale).astype(wdt)
-        wl = (_lut16_f32(lo) * scale).astype(wdt)
+        wh = (_lut16(hi, NF4_LEVELS) * scale).astype(wdt)
+        wl = (_lut16(lo, NF4_LEVELS) * scale).astype(wdt)
         acc = jnp.dot(xe_ref[:], wh, preferred_element_type=jnp.float32)
         acc = acc + jnp.dot(xo_ref[:], wl,
                             preferred_element_type=jnp.float32)

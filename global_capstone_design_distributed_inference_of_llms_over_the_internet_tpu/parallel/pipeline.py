@@ -223,11 +223,15 @@ class IciPipeline:
             )
         layers = stack_pipeline_params(params, num_stages)
         if tp == 1:
-            # Engine-side fused-QKV layout (bitwise-identical; TP keeps the
-            # canonical split so its per-projection shard boundaries hold).
-            from ..models.transformer import fuse_qkv_layers
+            # Engine-side fused QKV + gate/up layouts (bitwise-identical;
+            # TP keeps the canonical splits so its per-projection shard
+            # boundaries hold).
+            from ..models.transformer import (
+                fuse_gate_up_layers,
+                fuse_qkv_layers,
+            )
 
-            layers = fuse_qkv_layers(layers)
+            layers = fuse_gate_up_layers(fuse_qkv_layers(layers))
         layer_specs = _pipeline_layer_specs(cfg, layers, tp)
         layers = jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),

@@ -282,3 +282,44 @@ def test_fused_qkv_layers_bitwise_matches_canonical():
     got, kg, vg = full_forward(cfg, fused, ids, kc2, vc2, jnp.int32(0))
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
     np.testing.assert_array_equal(np.asarray(kr), np.asarray(kg))
+
+
+def test_fuse_gate_up_stacked_bitwise():
+    """fuse_gate_up_layers must FIRE on vmap-stacked dense trees (wg 3-D
+    [L, d, i] — the layout every engine passes; an ndim guard once made
+    it a silent no-op) and produce bitwise-identical logits; MoE expert
+    trees keep canonical."""
+    import jax.numpy as jnp
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+        full_forward,
+        init_kv_cache,
+        init_params,
+        llama_config,
+        mixtral_config,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.transformer import (
+        fuse_qkv_params,
+    )
+
+    cfg = llama_config(vocab_size=131, hidden_size=64, num_layers=3,
+                       num_heads=4, num_kv_heads=2, intermediate_size=96,
+                       max_position_embeddings=32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    fused = fuse_qkv_params(params)
+    assert "wgu" in fused["layers"]["mlp"], "gate+up fusion did not fire"
+    assert fused["layers"]["mlp"]["wgu"].shape == (3, 64, 192)
+    ids = jnp.asarray([[5, 9, 23, 7]], jnp.int32)
+    kc, vc = init_kv_cache(cfg, cfg.num_layers, 1, 16)
+    a, _, _ = full_forward(cfg, params, ids, kc, vc, jnp.int32(0))
+    kc, vc = init_kv_cache(cfg, cfg.num_layers, 1, 16)
+    b, _, _ = full_forward(cfg, fused, ids, kc, vc, jnp.int32(0))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    moe = mixtral_config(vocab_size=131, hidden_size=32, num_layers=2,
+                         num_heads=4, num_kv_heads=2, intermediate_size=64,
+                         num_experts=2, num_experts_per_tok=1,
+                         max_position_embeddings=32)
+    mp = fuse_qkv_params(init_params(jax.random.PRNGKey(1), moe))
+    assert "wgu" not in mp["layers"]["mlp"]      # experts stay canonical
+    assert "wg" in mp["layers"]["mlp"]
