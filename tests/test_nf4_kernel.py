@@ -1,7 +1,7 @@
 """Fused NF4 dequant-matmul Pallas kernel (ops.nf4_kernel).
 
 On-chip measurement (round 5, v5e): flagship nf4 fused decode 20.8 ms ->
-7.0 ms per step (2282 tokens/s) with NF4_KERNEL=1. CPU CI covers the
+6.8 ms per step (2359 tokens/s) with NF4_KERNEL=1. CPU CI covers the
 kernel's MATH via the Pallas interpreter and the dispatch plumbing; the
 speed claim lives in docs/PERFORMANCE.md.
 """
