@@ -197,6 +197,7 @@ def run_local(args, cfg: ModelConfig, params) -> int:
                 executor_kwargs={
                     "offload": args.use_cpu_offload,
                     "keep_layers_resident": args.keep_layers_on_gpu,
+                    "prefix_cache_bytes": args.prefix_cache_mb << 20,
                 },
                 num_blocks=num_blocks,
                 total_blocks=args.total_blocks or cfg.num_layers,
@@ -214,13 +215,15 @@ def run_local(args, cfg: ModelConfig, params) -> int:
                 cfg, spec, provider(spec), peer_id=peer,
                 offload=args.use_cpu_offload,
                 keep_layers_resident=args.keep_layers_on_gpu,
+                prefix_cache_bytes=args.prefix_cache_mb << 20,
             )
             transport.add_peer(peer, ex)
             registry.register(make_server_record(
                 peer, spec, model=_model_id(args)))
 
     stage0 = StageExecutor(cfg, plan.stages[0], provider(plan.stages[0]),
-                           peer_id="client-local")
+                           peer_id="client-local",
+                           prefix_cache_bytes=args.prefix_cache_mb << 20)
     client = PipelineClient(
         cfg, plan, stage0, transport, registry,
         use_module_routing=bool(args.use_load_balancing),
@@ -797,6 +800,11 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
     if args.sp > 1 and (args.batched or args.tp > 1 or args.use_cpu_offload):
         raise SystemExit("--sp does not compose with --batched/--tp/"
                          "--use_cpu_offload on one server")
+    if args.prefix_cache_mb and (args.batched or args.sp > 1):
+        raise SystemExit(
+            "--prefix_cache_mb is a per-session-executor feature; the "
+            "batched/sp engines manage KV slot- or mesh-wise and do not "
+            "consult the store — serve session replicas with it instead")
     if args.sp > 1:
         # Sequence-parallel long-context engine: ONE session at a time, its
         # prefix KV sharded along T over the local ('sp',) mesh.
@@ -844,7 +852,8 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
                  peer_id=peer_id,
                  offload=args.use_cpu_offload,
                  keep_layers_resident=args.keep_layers_on_gpu,
-                 tp_mesh=_serve_tp_mesh(args))
+                 tp_mesh=_serve_tp_mesh(args),
+                 prefix_cache_bytes=args.prefix_cache_mb << 20)
     logger.info("warming up stage %d (pre-compiling step shapes)", args.stage)
     if args.batched and getattr(args, "speculative_k", 0):
         # Warm the K+1-wide batched decode step too, so the first
@@ -986,7 +995,8 @@ def _run_serve_elastic(args, cfg: ModelConfig, params) -> int:
         bandwidth_mbps=args.network_bandwidth_mbps,
         executor_kwargs={"offload": args.use_cpu_offload,
                          "keep_layers_resident": args.keep_layers_on_gpu,
-                         "tp_mesh": _serve_tp_mesh(args)},
+                         "tp_mesh": _serve_tp_mesh(args),
+                         "prefix_cache_bytes": args.prefix_cache_mb << 20},
         advertise_address=advert, warmup=True,
         rng=random.Random(args.seed + os.getpid()),
         model=_model_id(args),
@@ -1074,6 +1084,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "run all stages in-process and ignore it.")
     p.add_argument("--dtype", choices=["float32", "bfloat16", "float16"],
                    default="float32")
+    p.add_argument("--prefix_cache_mb", type=int, default=0,
+                   help="enable the content-addressed prompt-prefix KV "
+                        "store with this byte budget (MiB) on session "
+                        "executors: repeat prefills reuse cached KV for "
+                        "shared prompt prefixes at 64-token granularity "
+                        "(runtime.prefix_cache). 0 = off")
     p.add_argument("--quant", choices=["none", "int8", "nf4"], default="none",
                    help="weight-only block quantization (reference V9 "
                         "surface: int8 per-channel, nf4 4-bit NormalFloat "

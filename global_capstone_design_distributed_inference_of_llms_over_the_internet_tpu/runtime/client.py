@@ -586,7 +586,8 @@ class PipelineClient:
               draft_tokens: Optional[Tuple[int, ...]] = None,
               start_from_position: Optional[int] = None,
               kind: str = "plain",
-              min_context: Optional[int] = None) -> StageResponse:
+              min_context: Optional[int] = None,
+              prefix_len: int = 0) -> StageResponse:
         """Send the activation through every remote hop; return the final
         hop's response: a sampled token, (num_logprobs > 0, beam mode)
         per-row top-N candidates, or (draft_tokens set, speculative mode)
@@ -627,6 +628,7 @@ class PipelineClient:
                 draft_tokens=draft_tokens,
                 start_from_position=start_from_position,
                 prompts=self._hop_prompts(session_id, hop, cur_len),
+                prefix_len=prefix_len if is_prefill else 0,
             )
             t0 = time.monotonic()
             resp = self._call_with_recovery(hop, req)
@@ -903,13 +905,14 @@ class PipelineClient:
             session_id=session_id, hidden=ids, seq_len=prompt_len, cur_len=0,
             is_prefill=True, max_length=max_length, sampling=sampling,
             prompts=self._span_prompts(session_id, s0.start, s0.end, 0),
+            prefix_len=prompt_len,
         ))
         times: Dict[str, float] = {}
         resp = self._walk(
             s0_resp.hidden, prompt_len, 0, session_id,
             is_prefill=True, max_length=max_length, sampling=sampling,
             generated=generated, step_seed=self.seed, stage_times=times,
-            kind=kind, min_context=max_length,
+            kind=kind, min_context=max_length, prefix_len=prompt_len,
         )
         ttft = time.monotonic() - t0
         self.last_prefill_stage_times = times

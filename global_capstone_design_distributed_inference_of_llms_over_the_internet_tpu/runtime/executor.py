@@ -169,6 +169,7 @@ class StageExecutor:
         keep_layers_resident: int = 0,
         tp_mesh: Optional["jax.sharding.Mesh"] = None,
         tp_axis: str = "tp",
+        prefix_cache_bytes: int = 0,
     ):
         self.cfg = cfg
         self.spec = spec
@@ -243,6 +244,14 @@ class StageExecutor:
         )
         self.debug_activation_checks = debug_activation_checks
         self.requests_served = 0
+        # Prompt-prefix KV reuse (runtime.prefix_cache): > 0 enables a
+        # bounded content-addressed store; repeat prefills copy cached KV
+        # rows instead of recomputing the span for the shared prefix.
+        self.prefix_store = None
+        if prefix_cache_bytes > 0:
+            from .prefix_cache import PrefixStore
+
+            self.prefix_store = PrefixStore(prefix_cache_bytes)
 
         # Sub-span execution units, keyed by relative layer range (a, b). A
         # request may cover only part of the loaded span (the uid-chain of
@@ -494,6 +503,62 @@ class StageExecutor:
         if t != t_real:
             raise StageExecutionError(f"seq_len {t_real} != tensor T {t}")
 
+        # Prompt-prefix reuse (runtime.prefix_cache): on a prefill whose
+        # leading grains were served before THROUGH THESE BLOCKS, copy the
+        # cached KV segments into the fresh arena lease and compute only the
+        # remainder. The rolling chain digest gives longest-shared-prefix
+        # matching at grain granularity — two prompts sharing a system
+        # preamble reuse its grains with no annotation of where it ends.
+        # The shareable region is clamped to t_real - 1 so the final stage
+        # always has a computed row to sample from. Exotic shapes (deep
+        # prompts, beam reorder, drafts) skip the path — their step
+        # semantics aren't a pure function of the prefix.
+        pfx_skip = 0
+        pfx_outs: list = []
+        pfx_register: list = []  # (key, grain_start, grain_end) to register
+        if (self.prefix_store is not None and req.is_prefill
+                and req.prefix_len > 0 and prompts is None
+                and req.hypo_ids is None and req.draft_tokens is None
+                and handle.k is not None):
+            from .prefix_cache import chain_digests
+
+            grain = self.prefix_store.grain
+            n_grains = min(req.prefix_len, t_real - 1) // grain
+            if n_grains > 0:
+                coords = (self.spec.start + a, self.spec.start + b,
+                          x.shape[0], str(x.dtype), str(self.cache_dtype),
+                          req.model)
+                # Digest from the HOST-side request buffer when the wire
+                # already delivered one — hashing the device copy would pay
+                # a D2H transfer + sync on every store-enabled prefill,
+                # misses included.
+                src = (req.hidden if isinstance(req.hidden, np.ndarray)
+                       else x)
+                xp = np.asarray(src[:, :n_grains * grain])
+                blocks = [
+                    np.ascontiguousarray(xp[:, g * grain:(g + 1) * grain])
+                    .tobytes() for g in range(n_grains)]
+                keys = chain_digests(blocks, coords)
+                chain = self.prefix_store.lookup_chain(
+                    keys, need_out=not sub_spec.is_last)
+                if chain:
+                    # One buffer-sized update per cache, not one per grain:
+                    # concatenate the chain's segments (cheap — segment-
+                    # sized) and write once at position 0.
+                    zeros = (0,) * handle.k.ndim
+                    kc = (chain[0].k if len(chain) == 1 else
+                          jnp.concatenate([e.k for e in chain], axis=2))
+                    vc = (chain[0].v if len(chain) == 1 else
+                          jnp.concatenate([e.v for e in chain], axis=2))
+                    handle.k = jax.lax.dynamic_update_slice(handle.k, kc, zeros)
+                    handle.v = jax.lax.dynamic_update_slice(handle.v, vc, zeros)
+                    pfx_outs = [e.out for e in chain if e.out is not None]
+                    pfx_skip = len(chain) * grain
+                    handle.advance(pfx_skip)
+                pfx_register = [
+                    (keys[g], g * grain, (g + 1) * grain)
+                    for g in range(len(chain), n_grains)]
+
         # Chunked prefill (petals backend.py:129-143): split an oversized
         # request into byte-bounded chunks over the same session cache. The
         # numerics are identical (each chunk attends causally to everything
@@ -504,7 +569,7 @@ class StageExecutor:
         # from the LAST chunk's logits only.
         chunk = self._max_chunk_tokens(x.shape[0])
         outs = []
-        off = 0
+        off = pfx_skip
         while off < t_real:
             n = min(chunk, t_real - off)
             xc = jax.lax.slice_in_dim(x, off, off + n, axis=1)
@@ -512,6 +577,23 @@ class StageExecutor:
                                              prompts=prompts))
             off += n
         self.requests_served += 1
+
+        if pfx_register:
+            # Register the grains the chain lookup didn't cover. KV rows
+            # come from the arena lease (already written by the chunk
+            # loop); intermediate stages also keep the output rows they'd
+            # need to forward on a future hit. Slicing copies — entries
+            # must outlive this session's arena buffers.
+            full = None
+            if not sub_spec.is_last:
+                full = (outs[0] if len(outs) == 1
+                        else jnp.concatenate(outs, axis=1))
+                outs = [full]
+            for key, g0, g1 in pfx_register:
+                out_rows = (None if full is None
+                            else full[:, g0 - pfx_skip:g1 - pfx_skip])
+                self.prefix_store.put(key, handle.k[:, :, g0:g1],
+                                      handle.v[:, :, g0:g1], out_rows)
 
         if sub_spec.is_last:
             if req.draft_tokens is not None:
@@ -538,6 +620,10 @@ class StageExecutor:
                 cache_len=handle.cache_len,
             )
         out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+        if pfx_outs:
+            # Hit: the next hop needs every token's hidden state — prepend
+            # the stored prefix segments' outputs to the computed suffix.
+            out = jnp.concatenate([*pfx_outs, out], axis=1)
         if self.debug_activation_checks:
             # Activation-explosion guard (src/rpc_handler.py:316-319). Opt-in:
             # the float() forces a host sync per hop per token, which would

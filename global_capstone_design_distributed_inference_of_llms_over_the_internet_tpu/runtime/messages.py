@@ -94,6 +94,13 @@ class StageRequest:
     # step instead of one per hop. Entries: {peer_id, address?, start_block,
     # end_block}.
     next_servers: Tuple[dict, ...] = ()
+    # Prompt-prefix sharing (runtime.prefix_cache; no reference
+    # counterpart): on a PREFILL, the client marks the leading prefix_len
+    # tokens as shareable across sessions. A server running a prefix store
+    # may then skip the span forward for those rows (content-addressed hit)
+    # and registers them on a miss. 0 = no sharing; servers without a store
+    # ignore the field, so clients annotate unconditionally.
+    prefix_len: int = 0
 
 
 @dataclasses.dataclass

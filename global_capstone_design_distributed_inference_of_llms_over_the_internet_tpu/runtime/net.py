@@ -268,6 +268,10 @@ def _request_header(req: StageRequest, tensor_meta: dict,
                          else list(req.draft_tokens)),
         "tensor": tensor_meta,
     }
+    if req.prefix_len:
+        # Prompt-prefix sharing marker (runtime.prefix_cache); absent for
+        # the common case so legacy peers see byte-identical headers.
+        hdr["prefix_len"] = req.prefix_len
     # Model identity echo: the data-plane counterpart of the reference's
     # model-prefixed DHT keys (src/dht_utils.py:20-31). A mis-routed request
     # (wrong model's server) must fail loudly, not produce garbage activations.
@@ -312,6 +316,7 @@ def _header_to_request(h: dict, payload: bytes) -> StageRequest:
                       else tuple(h["draft_tokens"])),
         model=h.get("model"),
         prompts=pr,
+        prefix_len=h.get("prefix_len", 0),
     )
 
 
@@ -695,6 +700,9 @@ class TcpStageServer(_FramedTcpServer):
             steps = getattr(getattr(ex, "inner", None), "decode_steps", None)
             if steps is not None:
                 frame["decode_steps"] = steps
+            store = getattr(ex, "prefix_store", None)
+            if store is not None:
+                frame["prefix_cache"] = store.stats()
             # Structured recent-request tail (_log_request parity): the
             # operator's first question about a misbehaving server is "what
             # has it been serving" — answerable over the wire.
@@ -792,6 +800,7 @@ class TcpStageServer(_FramedTcpServer):
             model=state["model"],
             next_servers=state["next_servers"],
             start_from_position=header.get("start_from_position"),
+            prefix_len=header.get("prefix_len", 0),
         )
         self._run_forward(sock, ex, req, stream=state,
                           step_timeout=state["step_timeout"])
@@ -1216,6 +1225,8 @@ class TcpTransport(Transport):
             }
             if request.is_prefill:
                 hdr["is_prefill"] = True
+                if request.prefix_len:
+                    hdr["prefix_len"] = request.prefix_len
             if request.start_from_position is not None:
                 hdr["start_from_position"] = request.start_from_position
             if st["returns_tokens"] and (

@@ -1,0 +1,166 @@
+"""Content-addressed prompt-prefix KV store (no reference counterpart).
+
+Serving workloads repeat prompt prefixes constantly — a shared system
+prompt, a few-shot preamble, a long document queried many times. The
+reference recomputes every prefill from scratch (its only prefill
+optimization is chunking one oversized request,
+``petals/server/backend.py:129-143``). This store lets a stage skip the
+span forward for a previously-seen prefix: on a prefill whose leading rows
+chain-hash to stored segments, the executor copies their KV rows into the
+session's arena lease and computes only the remainder.
+
+Design:
+
+* **Grain-chained block hashing.** The prefix is split into fixed
+  ``grain``-token segments; segment k is keyed by a ROLLING digest of
+  everything up to and including it (``d_k = H(coords || bytes[0:k*G])``,
+  one incremental sha256 pass with per-grain snapshots). Lookup walks
+  k = 1, 2, ... while the chain is unbroken — so two prompts sharing a
+  100-token system preamble reuse ``floor(100/G)`` grains automatically,
+  with no application-level annotation of where the shared part ends
+  (clients simply mark the whole prompt shareable). The rolling digest
+  makes a segment valid ONLY after its exact full prefix: segment content
+  is position-dependent (attention reads everything before it), which a
+  per-segment-only hash would get wrong.
+* **Content-addressed, not client-named.** The digest covers the actual
+  bytes entering the span (token ids on stage0, hidden-state rows
+  downstream) plus the execution coordinates (block range, batch, dtypes,
+  model tag). A client cannot poison another session's cache with a forged
+  id, and a hit is exact by construction — same bytes through same blocks.
+* **Per-segment storage** means overlapping prefixes share memory: each
+  entry holds only its own ``[L, B, G, H, Dh]`` KV rows (and, off the
+  final stage, its ``[B, G, D]`` output rows — a chained stage must still
+  FORWARD the prefix's output to the next hop). Evicting a middle link
+  merely shortens every chain through it; lookup stops at the first
+  missing link.
+* **Bounded bytes, LRU.** A lookup touches every link it uses — root
+  last, so the link every chain depends on is the warmest of its chain.
+
+Accepted tradeoff — the classic shared-prefix-cache timing channel: the
+store is server-wide, so a client who can GUESS another session's prompt
+prefix can confirm it was recently served by observing TTFT collapse (and
+hit counters move on the ``info`` verb). That is inherent to cross-session
+prefix sharing (vLLM/SGLang prefix caches share it); deployments serving
+mutually untrusted tenants with secret prompts should leave the store off
+(its default) or partition tenants across servers. Content addressing
+still rules out the worse failure — serving one tenant's cached KV for a
+DIFFERENT prefix — by construction.
+
+Thread-safe: serving engines run compute on one thread, but LocalTransport
+tests (and batched-adapter handler threads) may race get/put.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+# Tokens per cached segment. Smaller = finer shared-prefix matching but
+# more entries and more copy calls per hit; 64 keeps a segment's KV write
+# one cheap dynamic_update_slice while matching system prompts closely.
+DEFAULT_GRAIN = 64
+
+
+@dataclasses.dataclass
+class PrefixEntry:
+    """One grain's KV rows (k/v: ``[span_layers, B, G, kv_heads, head_dim]``)
+    and, off the final stage, its output hidden rows (out: ``[B, G, D]``)."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    out: Optional[jnp.ndarray]
+    nbytes: int
+
+
+def chain_digests(prefix_bytes_per_grain: List[bytes], coords: tuple) -> List[str]:
+    """Rolling digests d_1..d_K over grain-sized byte blocks: d_k commits to
+    coords + ALL bytes through grain k (one pass, snapshot per grain)."""
+    h = hashlib.sha256(repr(coords).encode())
+    out = []
+    for blk in prefix_bytes_per_grain:
+        h.update(blk)
+        out.append(h.hexdigest())
+    return out
+
+
+class PrefixStore:
+    """Bounded LRU of :class:`PrefixEntry` keyed by rolling chain digest."""
+
+    def __init__(self, max_bytes: int, grain: int = DEFAULT_GRAIN):
+        self.max_bytes = int(max_bytes)
+        self.grain = int(grain)
+        self._entries: "OrderedDict[str, PrefixEntry]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.used_bytes = 0
+        self.hits = 0          # lookups that reused >= 1 grain
+        self.misses = 0        # lookups that reused none
+        self.grains_reused = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def lookup_chain(self, keys: List[str],
+                     need_out: bool) -> List[PrefixEntry]:
+        """Longest unbroken chain of stored segments for rolling digests
+        ``keys``; with ``need_out`` (intermediate stages) a KV-only link
+        ends the chain. Touches every returned link (LRU)."""
+        got: List[PrefixEntry] = []
+        used: List[str] = []
+        with self._lock:
+            for key in keys:
+                entry = self._entries.get(key)
+                if entry is None or (need_out and entry.out is None):
+                    break
+                used.append(key)
+                got.append(entry)
+            # Touch ROOT-LAST: a chain is only reachable through its first
+            # link, so the root must be the warmest of its chain — touching
+            # in walk order would evict roots first and strand every
+            # descendant as unreachable dead weight.
+            for key in reversed(used):
+                self._entries.move_to_end(key)
+            if got:
+                self.hits += 1
+                self.grains_reused += len(got)
+            elif keys:
+                self.misses += 1
+        return got
+
+    def put(self, key: str, k: jnp.ndarray, v: jnp.ndarray,
+            out: Optional[jnp.ndarray]) -> bool:
+        """Insert one segment (idempotent per key), evicting LRU entries to
+        fit. Returns False when the segment alone exceeds the budget."""
+        nbytes = int(k.nbytes) + int(v.nbytes) + (
+            int(out.nbytes) if out is not None else 0)
+        if nbytes > self.max_bytes:
+            return False
+        entry = PrefixEntry(k=k, v=v, out=out, nbytes=nbytes)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.used_bytes -= old.nbytes
+            while self.used_bytes + nbytes > self.max_bytes and self._entries:
+                _, victim = self._entries.popitem(last=False)
+                self.used_bytes -= victim.nbytes
+                self.evictions += 1
+            self._entries[key] = entry
+            self.used_bytes += nbytes
+        return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self.used_bytes,
+                "grain": self.grain,
+                "hits": self.hits,
+                "misses": self.misses,
+                "grains_reused": self.grains_reused,
+                "evictions": self.evictions,
+            }

@@ -60,8 +60,8 @@ SHARDS = [
     ["test_models_oracle.py", "test_multi_model.py", "test_net.py",
      "test_offload.py", "test_partition.py", "test_registry_ha.py"],
     # 4: protocol extensions
-    ["test_push_chain.py", "test_nf4_kernel.py", "test_quant.py",
-     "test_quarantine_hook.py", "test_remote_store.py",
+    ["test_push_chain.py", "test_nf4_kernel.py", "test_prefix_cache.py",
+     "test_quant.py", "test_quarantine_hook.py", "test_remote_store.py",
      "test_ring_attention.py", "test_ring_decode.py",
      "test_routing_rtt.py"],
     # 5: pipeline runtime + serving engines

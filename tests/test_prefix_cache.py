@@ -1,0 +1,280 @@
+"""Prompt-prefix KV reuse (runtime.prefix_cache) — no reference counterpart.
+
+The store is content-addressed with grain-chained rolling digests, so two
+prompts sharing a system preamble reuse its grains automatically. A hit is
+exact in content (same bytes through the same blocks); outputs are compared
+at the chunk-boundary fp tolerance the suite uses for chunked prefill (the
+warm suffix runs under a different seq-bucket shape than the cold one-shot
+prefill, so fusion differences move the last ulp, not the math).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+    init_params,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.partition import (
+    StagePlan,
+    parse_splits,
+    slice_stage_params,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.ops.sampling import (
+    SamplingParams,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.executor import (
+    StageExecutor,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.messages import (
+    StageRequest,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.net import (
+    _header_to_request,
+    _request_header,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.prefix_cache import (
+    PrefixStore,
+    chain_digests,
+)
+
+from test_runtime_pipeline import tiny_cfg
+
+GRAIN = 8
+
+
+# ---------------------------------------------------------------------------
+# Store unit tests
+# ---------------------------------------------------------------------------
+
+def _seg(val, nbytes=64):
+    a = jnp.full((1, 1, GRAIN, 1, 2), float(val), jnp.float32)
+    return a, a, jnp.full((1, GRAIN, 2), float(val), jnp.float32)
+
+
+def test_store_chain_lookup_stops_at_first_missing():
+    st = PrefixStore(1 << 20, grain=GRAIN)
+    keys = chain_digests([b"a", b"b", b"c"], coords=("t",))
+    k, v, out = _seg(1)
+    st.put(keys[0], k, v, out)
+    st.put(keys[2], k, v, out)  # keys[1] missing -> chain ends after 1
+    got = st.lookup_chain(keys, need_out=True)
+    assert len(got) == 1
+    assert st.hits == 1 and st.grains_reused == 1
+
+
+def test_store_need_out_breaks_on_kv_only_entry():
+    st = PrefixStore(1 << 20, grain=GRAIN)
+    keys = chain_digests([b"a", b"b"], coords=("t",))
+    k, v, out = _seg(1)
+    st.put(keys[0], k, v, None)
+    st.put(keys[1], k, v, out)
+    assert st.lookup_chain(keys, need_out=True) == []
+    assert len(st.lookup_chain(keys, need_out=False)) == 2
+
+
+def test_store_lru_eviction_bounded():
+    k, v, out = _seg(1)
+    per = int(k.nbytes + v.nbytes + out.nbytes)
+    st = PrefixStore(per * 2, grain=GRAIN)
+    keys = chain_digests([b"a", b"b", b"c"], coords=("t",))
+    for key in keys:
+        assert st.put(key, k, v, out)
+    assert len(st) == 2 and st.evictions == 1
+    assert st.used_bytes <= st.max_bytes
+    # oldest evicted -> chain broken at first key
+    assert st.lookup_chain(keys, need_out=True) == []
+    # oversized entry refused
+    tiny = PrefixStore(per - 1, grain=GRAIN)
+    assert not tiny.put(keys[0], k, v, out)
+
+
+def test_rolling_digest_is_position_dependent():
+    d1 = chain_digests([b"aa", b"bb"], coords=("c",))
+    d2 = chain_digests([b"bb", b"bb"], coords=("c",))
+    # same 2nd-grain bytes, different prefix -> different 2nd digest
+    assert d1[1] != d2[1]
+    assert chain_digests([b"aa"], coords=("c",)) != chain_digests(
+        [b"aa"], coords=("other",))
+
+
+def test_wire_header_roundtrip_prefix_len():
+    req = StageRequest(session_id="s", hidden=jnp.zeros((1, 4, 8)),
+                       seq_len=4, cur_len=0, is_prefill=True, max_length=32,
+                       prefix_len=4)
+    hdr = _request_header(req, {"dtype": "f32", "shape": [1, 4, 8]})
+    body = np.zeros((1, 4, 8), np.float32).tobytes()
+    back = _header_to_request(hdr, body)
+    assert back.prefix_len == 4
+    # absent for the common case (legacy header compatibility)
+    req0 = StageRequest(session_id="s", hidden=jnp.zeros((1, 4, 8)),
+                        seq_len=4, cur_len=0, is_prefill=True, max_length=32)
+    assert "prefix_len" not in _request_header(
+        req0, {"dtype": "f32", "shape": [1, 4, 8]})
+
+
+# ---------------------------------------------------------------------------
+# Executor integration
+# ---------------------------------------------------------------------------
+
+def _seg_executor(cfg, params, cache_mb=64):
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("2,6"))
+    spec = plan.stages[1]  # layers [2, 6)
+    ex = StageExecutor(cfg, spec, slice_stage_params(cfg, params, spec),
+                       peer_id="seg",
+                       prefix_cache_bytes=cache_mb << 20)
+    ex.prefix_store.grain = GRAIN  # fine-grained for small test prompts
+    return ex
+
+
+def _prefill(ex, sid, hid, prefix_len):
+    return ex.forward(StageRequest(
+        session_id=sid, hidden=jnp.asarray(hid), seq_len=hid.shape[1],
+        cur_len=0, is_prefill=True, max_length=64, prefix_len=prefix_len))
+
+
+def _decode(ex, sid, hid, cur_len):
+    return ex.forward(StageRequest(
+        session_id=sid, hidden=jnp.asarray(hid), seq_len=1, cur_len=cur_len,
+        is_prefill=False, max_length=64))
+
+
+def test_segment_hit_is_bitwise_exact_through_decode():
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(1)
+    hid = rng.standard_normal((1, 40, cfg.hidden_size)).astype(np.float32)
+
+    ex = _seg_executor(cfg, params)
+    cold = _prefill(ex, "cold", hid, prefix_len=40)
+    st = ex.prefix_store.stats()
+    # min(40, 39) // 8 = 4 grains registered on the miss
+    assert st == {**st, "entries": 4, "misses": 1, "hits": 0}
+
+    warm = _prefill(ex, "warm", hid, prefix_len=40)
+    st = ex.prefix_store.stats()
+    assert st["hits"] == 1 and st["grains_reused"] == 4
+    np.testing.assert_allclose(np.asarray(cold.hidden),
+                               np.asarray(warm.hidden), atol=1e-5, rtol=1e-5)
+    assert warm.cache_len == 40
+
+    # decode must continue bitwise-identically from the copied KV
+    step = rng.standard_normal((1, 1, cfg.hidden_size)).astype(np.float32)
+    for i in range(3):
+        rc = _decode(ex, "cold", step, 40 + i)
+        rw = _decode(ex, "warm", step, 40 + i)
+        np.testing.assert_allclose(np.asarray(rc.hidden),
+                                   np.asarray(rw.hidden), atol=1e-5, rtol=1e-5)
+
+
+def test_shared_prefix_divergent_suffix_matches_uncached():
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(2)
+    shared = rng.standard_normal((1, 32, cfg.hidden_size)).astype(np.float32)
+    tail_a = rng.standard_normal((1, 9, cfg.hidden_size)).astype(np.float32)
+    tail_b = rng.standard_normal((1, 9, cfg.hidden_size)).astype(np.float32)
+    hid_a = np.concatenate([shared, tail_a], axis=1)
+    hid_b = np.concatenate([shared, tail_b], axis=1)
+
+    cached = _seg_executor(cfg, params)
+    _prefill(cached, "a", hid_a, prefix_len=41)
+    warm_b = _prefill(cached, "b", hid_b, prefix_len=41)
+    st = cached.prefix_store.stats()
+    # prompts diverge after 32 rows -> exactly 4 shared grains reused
+    assert st["hits"] == 1 and st["grains_reused"] == 4
+
+    oracle = StageExecutor(
+        cfg, cached.spec, slice_stage_params(cfg, params, cached.spec),
+        peer_id="oracle")
+    cold_b = _prefill(oracle, "b", hid_b, prefix_len=0)
+    np.testing.assert_allclose(np.asarray(cold_b.hidden),
+                               np.asarray(warm_b.hidden), atol=1e-5, rtol=1e-5)
+
+
+def test_final_stage_hit_keeps_sampled_token():
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("2,6"))
+    spec = plan.stages[-1]  # layers [6, 8) + head
+    ex = StageExecutor(cfg, spec, slice_stage_params(cfg, params, spec),
+                       peer_id="last", prefix_cache_bytes=64 << 20)
+    ex.prefix_store.grain = GRAIN
+    rng = np.random.default_rng(3)
+    hid = rng.standard_normal((1, 33, cfg.hidden_size)).astype(np.float32)
+
+    def prefill(sid):
+        return ex.forward(StageRequest(
+            session_id=sid, hidden=jnp.asarray(hid), seq_len=33, cur_len=0,
+            is_prefill=True, max_length=64, prefix_len=33,
+            sampling=SamplingParams(temperature=0.0)))
+
+    cold = prefill("cold")
+    warm = prefill("warm")
+    # min(33, 32) // 8 = 4 grains; final stage stores KV-only entries
+    assert ex.prefix_store.stats()["grains_reused"] == 4
+    assert cold.token_id == warm.token_id
+    assert warm.cache_len == 33
+
+
+def test_prefix_len_clamp_never_skips_last_row():
+    """prefix_len == seq_len must leave >= 1 computed row (the final stage
+    samples from it): with T = 32 and grain 8, only 3 grains are usable."""
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ex = _seg_executor(cfg, params)
+    hid = np.random.default_rng(4).standard_normal(
+        (1, 32, cfg.hidden_size)).astype(np.float32)
+    a = _prefill(ex, "a", hid, prefix_len=32)
+    warm = _prefill(ex, "b", hid, prefix_len=32)
+    assert ex.prefix_store.stats()["grains_reused"] == 3
+    np.testing.assert_allclose(np.asarray(a.hidden),
+                               np.asarray(warm.hidden), atol=1e-5, rtol=1e-5)
+
+
+def test_exotic_requests_bypass_store():
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ex = _seg_executor(cfg, params)
+    hid = np.random.default_rng(5).standard_normal(
+        (1, 24, cfg.hidden_size)).astype(np.float32)
+    prompts = np.zeros((4, 2, cfg.hidden_size), np.float32)
+    ex.forward(StageRequest(
+        session_id="dp", hidden=jnp.asarray(hid), seq_len=24, cur_len=0,
+        is_prefill=True, max_length=64, prefix_len=24,
+        prompts=jnp.asarray(prompts)))
+    st = ex.prefix_store.stats()
+    assert st["entries"] == 0 and st["hits"] == 0 and st["misses"] == 0
+
+
+def test_end_to_end_client_reuse_token_parity():
+    """Two PipelineClient generations with the same prompt: the second hits
+    every server's store and produces identical tokens; a shared-prefix
+    third prompt reuses only the shared grains and still matches a
+    cache-free cluster."""
+    from test_runtime_pipeline import build_cluster
+
+    cfg = tiny_cfg()
+    client, transport, registry, params, plan = build_cluster(cfg)
+    stores = []
+    for pid in transport.peers():
+        ex = transport.executor(pid)
+        ex.prefix_store = PrefixStore(64 << 20, grain=GRAIN)
+        stores.append(ex.prefix_store)
+    prompt = list(range(7, 47))  # 40 tokens -> 4 reusable grains of 8
+    sampling = SamplingParams(temperature=0.0)
+
+    r1 = client.generate(prompt, max_new_tokens=6, sampling=sampling)
+    assert all(s.stats()["misses"] == 1 for s in stores)
+    r2 = client.generate(prompt, max_new_tokens=6, sampling=sampling)
+    assert r1.tokens == r2.tokens
+    assert all(s.stats()["hits"] == 1 for s in stores)
+    assert all(s.stats()["grains_reused"] == 4 for s in stores)
+
+    # divergent tail after 32 shared tokens
+    prompt3 = prompt[:32] + [101, 102, 103, 104, 105, 106, 107, 108]
+    r3 = client.generate(prompt3, max_new_tokens=6, sampling=sampling)
+    fresh_client, *_ = build_cluster(cfg)
+    r3_oracle = fresh_client.generate(prompt3, max_new_tokens=6,
+                                      sampling=sampling)
+    assert r3.tokens == r3_oracle.tokens
