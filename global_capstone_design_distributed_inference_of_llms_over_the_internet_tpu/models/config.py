@@ -16,7 +16,7 @@ from typing import Optional
 class ModelConfig:
     """Architecture hyperparameters for one decoder-only transformer family."""
 
-    model_type: str  # "gpt2" | "llama" | "mistral" | "mixtral" | "qwen2"
+    model_type: str  # "gpt2" | "llama" | "mistral" | "mixtral" | "qwen2" | "gemma"
     vocab_size: int
     hidden_size: int
     num_layers: int
@@ -52,16 +52,31 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
 
+    # Gemma-family switches:
+    # head_dim decoupled from hidden_size/num_heads (gemma-7b: hidden 3072,
+    # 16 heads, head_dim 256 — the projections are [D, H*Dh] with
+    # H*Dh != D). None = the usual hidden/heads.
+    head_dim_override: Optional[int] = None
+    # RMSNorm weights stored as an OFFSET from one: effective scale is
+    # (1 + w), zero-init (the HF Gemma convention — keeping the stored
+    # layout means convert_state_dict needs no rewrite pass).
+    norm_offset: bool = False
+    # Multiply token embeddings by sqrt(hidden_size) (Gemma "normalizer").
+    embed_scale: bool = False
+
     @property
     def head_dim(self) -> int:
-        return self.hidden_size // self.num_heads
+        return (self.head_dim_override
+                if self.head_dim_override is not None
+                else self.hidden_size // self.num_heads)
 
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
 
     def __post_init__(self):
-        assert self.hidden_size % self.num_heads == 0
+        if self.head_dim_override is None:
+            assert self.hidden_size % self.num_heads == 0
         assert self.num_heads % self.num_kv_heads == 0
 
 
@@ -138,6 +153,22 @@ def qwen2_config(norm_eps: float = 1e-6, **kw) -> ModelConfig:
     return dataclasses.replace(cfg, model_type="qwen2", attn_qkv_bias=True)
 
 
+def gemma_config(head_dim: int = 256, norm_eps: float = 1e-6,
+                 rope_theta: float = 10000.0,
+                 tie_word_embeddings: bool = True, **kw) -> ModelConfig:
+    """Gemma (1): LLaMA skeleton with four architectural twists — GeGLU
+    (tanh-gelu gate in the gated MLP), RMSNorm as a (1 + w) offset scale,
+    token embeddings multiplied by sqrt(hidden), and head_dim decoupled
+    from hidden/heads. Extends the reference's model-family guard
+    (``src/llama_partition.py:82-83`` accepts llama/mistral/mixtral only).
+    """
+    cfg = llama_config(norm_eps=norm_eps, rope_theta=rope_theta,
+                       tie_word_embeddings=tie_word_embeddings, **kw)
+    return dataclasses.replace(
+        cfg, model_type="gemma", activation="gelu_tanh",
+        head_dim_override=head_dim, norm_offset=True, embed_scale=True)
+
+
 def mixtral_config(num_experts: int = 8, num_experts_per_tok: int = 2, **kw) -> ModelConfig:
     cfg = llama_config(**kw)
     return dataclasses.replace(
@@ -175,6 +206,16 @@ PRESETS = {
     "mixtral-8x7b": lambda: mixtral_config(
         vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
         num_kv_heads=8, intermediate_size=14336,
+    ),
+    "gemma-2b": lambda: gemma_config(
+        vocab_size=256000, hidden_size=2048, num_layers=18, num_heads=8,
+        num_kv_heads=1, intermediate_size=16384,
+        max_position_embeddings=8192,
+    ),
+    "gemma-7b": lambda: gemma_config(
+        vocab_size=256000, hidden_size=3072, num_layers=28, num_heads=16,
+        num_kv_heads=16, intermediate_size=24576,
+        max_position_embeddings=8192,
     ),
     "qwen2-0.5b": lambda: qwen2_config(
         vocab_size=151936, hidden_size=896, num_layers=24, num_heads=14,

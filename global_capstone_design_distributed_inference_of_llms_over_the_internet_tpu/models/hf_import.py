@@ -137,6 +137,14 @@ def config_from_hf(hf_cfg) -> ModelConfig:
         return mistral_config(
             sliding_window=getattr(hf_cfg, "sliding_window", None), **common
         )
+    if mt == "gemma":
+        from .config import gemma_config
+
+        # HF GemmaConfig historically defaulted hidden_act to "gelu" while
+        # checkpoints run gelu_pytorch_tanh (transformers#29402); both map
+        # to the tanh approximation here. norm_eps/tie_word_embeddings ride
+        # in via `common` (GemmaConfig always defines both attributes).
+        return gemma_config(head_dim=hf_cfg.head_dim, **common)
     if mt == "mixtral":
         cfg = mixtral_config(
             num_experts=hf_cfg.num_local_experts,
@@ -152,7 +160,7 @@ def config_from_hf(hf_cfg) -> ModelConfig:
     # Mirrors the reference's model_type guard (src/llama_partition.py:82-83).
     raise ValueError(
         f"unsupported model_type: {mt} "
-        "(expected gpt2/llama/mistral/mixtral/qwen2)")
+        "(expected gpt2/llama/mistral/mixtral/qwen2/gemma)")
 
 
 def _gpt2_layer(sd: Mapping[str, Any], i: int) -> Params:

@@ -22,6 +22,7 @@ import directly and HF Linear weights import transposed.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -60,8 +61,11 @@ def init_layer_params(rng: jax.Array, cfg: ModelConfig, dtype=jnp.float32) -> Pa
         p["ln1"] = {"w": jnp.ones((d,), dtype), "b": jnp.zeros((d,), dtype)}
         p["ln2"] = {"w": jnp.ones((d,), dtype), "b": jnp.zeros((d,), dtype)}
     else:
-        p["ln1"] = {"w": jnp.ones((d,), dtype)}
-        p["ln2"] = {"w": jnp.ones((d,), dtype)}
+        # norm_offset (gemma): stored weight is the offset from one, so the
+        # identity init is zeros, not ones.
+        one = jnp.zeros((d,), dtype) if cfg.norm_offset else jnp.ones((d,), dtype)
+        p["ln1"] = {"w": one}
+        p["ln2"] = {"w": one}
     if cfg.use_bias or cfg.attn_qkv_bias:
         p["attn"]["bq"] = jnp.zeros((h * dh,), dtype)
         p["attn"]["bk"] = jnp.zeros((hkv * dh,), dtype)
@@ -112,6 +116,8 @@ def init_params(rng: jax.Array, cfg: ModelConfig, dtype=jnp.float32) -> Params:
             "w": jnp.ones((cfg.hidden_size,), dtype),
             "b": jnp.zeros((cfg.hidden_size,), dtype),
         }
+    elif cfg.norm_offset:
+        final_norm = {"w": jnp.zeros((cfg.hidden_size,), dtype)}
     else:
         final_norm = {"w": jnp.ones((cfg.hidden_size,), dtype)}
 
@@ -129,6 +135,11 @@ def embed_tokens(cfg: ModelConfig, embed: Params, input_ids: jnp.ndarray,
                  positions: jnp.ndarray) -> jnp.ndarray:
     """input_ids: [B, T] int32; positions: [B, T] int32 -> hidden [B, T, D]."""
     h = jnp.take(embed["wte"], input_ids, axis=0)
+    if cfg.embed_scale:
+        # Gemma normalizer: sqrt(hidden) rounded to the activation dtype
+        # first (HF casts the scalar before multiplying — matching the
+        # rounding keeps bf16 parity exact).
+        h = h * jnp.asarray(cfg.hidden_size ** 0.5).astype(h.dtype)
     if cfg.positional == "learned":
         # Clip keeps the gather in-bounds under jit; generating past
         # max_position_embeddings must be rejected by session-level max-length
@@ -258,13 +269,16 @@ def _mlp(cfg: ModelConfig, p: Params, x: jnp.ndarray, tp_axis: Optional[str]) ->
     if cfg.is_moe:
         return _moe_mlp(cfg, p, x, tp_axis)
     if cfg.mlp == "swiglu":
+        # Gate activation: silu (llama family) or tanh-gelu (gemma GeGLU).
+        act = (partial(jax.nn.gelu, approximate=True)
+               if cfg.activation == "gelu_tanh" else jax.nn.silu)
         if "wgu" in p:               # engine-fused layout (fuse_gate_up)
             gu = _dot(x, p["wgu"])
             i = gu.shape[-1] // 2
-            gate = jax.nn.silu(gu[..., :i])
+            gate = act(gu[..., :i])
             up = gu[..., i:]
         else:
-            gate = jax.nn.silu(_dot(x, p["wg"]))
+            gate = act(_dot(x, p["wg"]))
             up = _dot(x, p["wu"])
         return _psum_if(_dot(gate * up, p["wd"]), tp_axis)
     y = _dot(x, p["wi"])
@@ -377,6 +391,10 @@ def _attention(
 def _norm(cfg: ModelConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
     if cfg.norm == "layernorm":
         return layer_norm(x, p["w"], p["b"], cfg.norm_eps)
+    if cfg.norm_offset:
+        # Gemma convention: stored weight is the offset from one (the
+        # add runs in rms_norm's f32 accumulation lane).
+        return rms_norm(x, 1.0 + p["w"].astype(jnp.float32), cfg.norm_eps)
     return rms_norm(x, p["w"], cfg.norm_eps)
 
 

@@ -78,12 +78,28 @@ def tiny_qwen2():
     return Qwen2ForCausalLM(hf_cfg).eval()
 
 
+def tiny_gemma():
+    torch.manual_seed(0)
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    # head_dim=32 != hidden/heads=16 exercises the decoupled-head-dim path
+    # (gemma-7b ships 3072/16 heads with head_dim 256).
+    hf_cfg = GemmaConfig(
+        vocab_size=320, hidden_size=64, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=128,
+        head_dim=32, max_position_embeddings=128, rope_theta=10000.0,
+        hidden_activation="gelu_pytorch_tanh", tie_word_embeddings=True,
+    )
+    return GemmaForCausalLM(hf_cfg).eval()
+
+
 FACTORIES = {
     "gpt2": tiny_gpt2,
     "llama": tiny_llama,
     "mistral": tiny_mistral,
     "mixtral": tiny_mixtral,
     "qwen2": tiny_qwen2,
+    "gemma": tiny_gemma,
 }
 
 
@@ -112,7 +128,7 @@ def test_prefill_logits_match_hf(family):
     assert (np.asarray(logits).argmax(-1) == ref_logits.argmax(-1)).all()
 
 
-@pytest.mark.parametrize("family", ["gpt2", "llama", "qwen2"])
+@pytest.mark.parametrize("family", ["gpt2", "llama", "qwen2", "gemma"])
 def test_incremental_decode_matches_full_recompute(family):
     """Prefill + per-token decode through the KV cache must equal one full
     forward over the whole sequence (the cache is exact, not approximate)."""

@@ -30,6 +30,15 @@ def tiny_cfg(family):
     if family == "gpt2":
         return gpt2_config(vocab_size=257, hidden_size=64, num_layers=8,
                            num_heads=4, max_position_embeddings=64)
+    if family == "gemma":
+        from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+            gemma_config,
+        )
+
+        return gemma_config(vocab_size=257, hidden_size=64, num_layers=8,
+                            num_heads=4, num_kv_heads=2,
+                            intermediate_size=128, head_dim=32,
+                            max_position_embeddings=64)
     return llama_config(vocab_size=257, hidden_size=64, num_layers=8,
                         num_heads=4, num_kv_heads=2, intermediate_size=128,
                         max_position_embeddings=64)
@@ -77,7 +86,7 @@ def test_bad_splits_rejected():
         StagePlan.from_splits(8, [0, 4])
 
 
-@pytest.mark.parametrize("family", ["gpt2", "llama"])
+@pytest.mark.parametrize("family", ["gpt2", "llama", "gemma"])
 @pytest.mark.parametrize("splits", ["3,6", "2,4,6"])
 def test_staged_pipeline_equals_full_forward(family, splits):
     cfg = tiny_cfg(family)
