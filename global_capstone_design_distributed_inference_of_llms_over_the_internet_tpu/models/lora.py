@@ -1,0 +1,148 @@
+"""LoRA adapters for the distributed fine-tuning path.
+
+The reference's (vendored, unrunnable) training surface tunes only deep
+prompts (``petals/server/block_functions.py:57-65``); upstream Petals adds
+server-side PEFT adapters chosen by name. Here adapters are CLIENT-OWNED
+trainables, shipped with each training RPC exactly like prompt slices: the
+server stays stateless and frozen, any client can train its own adapters
+against shared frozen blocks, and fault tolerance stays "re-route and
+retry the step" — no server-side adapter registry to keep consistent.
+
+A LoRA adapter for target weight ``W: [D, O]`` is a pair ``a: [D, r]``,
+``b: [r, O]`` with effective weight ``W + (alpha / r) * a @ b``. ``b`` is
+zero-initialized so training starts from the frozen model exactly.
+
+Tree layout mirrors the stacked layer params: per target name (a key into
+``layers["attn"]``, e.g. ``wq``/``wv``), ``{"a": [L, D, r], "b": [L, r, O]}``
+with the leading layer axis — sliceable per block span the same way prompts
+are, and scannable alongside the layers.
+
+``merge_lora`` materializes adapted weights functionally (``W + scale·a@b``
+under jit), so autodiff flows into ``a``/``b`` with no changes to the layer
+math; at rank ``r << D`` the per-layer delta matmul is noise next to the
+block's own GEMMs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import ModelConfig
+
+Params = Dict[str, Any]
+
+DEFAULT_TARGETS = ("wq", "wv")  # the classic LoRA attention pair
+
+
+def target_out_dim(cfg: ModelConfig, target: str) -> int:
+    """Output width of an attention projection target."""
+    dh = cfg.head_dim
+    if target == "wq":
+        return cfg.num_heads * dh
+    if target in ("wk", "wv"):
+        return cfg.num_kv_heads * dh
+    if target == "wo":
+        return cfg.hidden_size
+    raise ValueError(f"unsupported LoRA target {target!r} "
+                     "(expected wq/wk/wv/wo)")
+
+
+def init_lora(
+    rng: jax.Array,
+    cfg: ModelConfig,
+    num_layers: int,
+    rank: int,
+    targets: Sequence[str] = DEFAULT_TARGETS,
+    init_scale: float = 0.01,
+    dtype=jnp.float32,
+) -> Params:
+    """a ~ N(0, init_scale), b = 0 — the standard LoRA start (delta == 0)."""
+    tree: Params = {}
+    for t in sorted(targets):
+        rng, k = jax.random.split(rng)
+        o = target_out_dim(cfg, t)
+        tree[t] = {
+            "a": init_scale * jax.random.normal(
+                k, (num_layers, cfg.hidden_size, rank), dtype),
+            "b": jnp.zeros((num_layers, rank, o), dtype),
+        }
+    return tree
+
+
+def slice_lora(lora: Params, start: int, end: int) -> Params:
+    """The [start, end) block span's adapter slice (same semantics as the
+    per-hop prompt slice)."""
+    return jax.tree.map(lambda x: x[start:end], lora)
+
+
+def _fused_qkv_offset(cfg: ModelConfig, wqkv_width: int, target: str) -> int:
+    """Column offset of a q/k/v target inside an engine-fused ``wqkv``
+    (layout [q | k | v], transformer.fuse_qkv_layers)."""
+    hd = wqkv_width * cfg.num_heads // (cfg.num_heads + 2 * cfg.num_kv_heads)
+    kd = (wqkv_width - hd) // 2
+    return {"wq": 0, "wk": hd, "wv": hd + kd}[target]
+
+
+def merge_lora(cfg: ModelConfig, layers: Params, lora: Optional[Params],
+               scale: float) -> Params:
+    """Stacked layer params with each target's effective weight
+    ``W + scale * a @ b`` ([L, D, O] einsum over the layer axis). Leaves
+    everything else aliased — only adapted targets are new arrays.
+
+    Handles both weight layouts: canonical per-projection ``wq/wk/wv/wo``
+    and the engine-fused ``wqkv`` (serving executors fuse at load,
+    transformer.fuse_qkv_params) — there the delta lands on the target's
+    column slice of the fused matrix, which is exactly equivalent (fusing
+    along N never mixes columns)."""
+    if lora is None or not lora:
+        return layers
+    attn = dict(layers["attn"])
+    for t, ab in lora.items():
+        delta = jnp.einsum("ldr,lro->ldo", ab["a"], ab["b"])
+        if t in attn:
+            attn[t] = attn[t] + scale * delta.astype(attn[t].dtype)
+        elif "wqkv" in attn and t in ("wq", "wk", "wv"):
+            w = attn["wqkv"]
+            off = _fused_qkv_offset(cfg, w.shape[-1], t)
+            o = delta.shape[-1]
+            attn["wqkv"] = w.at[..., off:off + o].add(
+                scale * delta.astype(w.dtype))
+        else:
+            raise ValueError(
+                f"LoRA target {t!r} not present in layer params")
+    return {**layers, "attn": attn}
+
+
+# ---------------------------------------------------------------------------
+# Wire helpers: a deterministic flatten so adapters ride multi-tensor frames
+# ---------------------------------------------------------------------------
+
+def lora_to_list(lora: Params) -> Tuple[List[str], List[jnp.ndarray]]:
+    """(manifest, arrays): manifest entries are "target/leaf" in sorted
+    order; inverse of `lora_from_list`."""
+    manifest: List[str] = []
+    arrays: List[jnp.ndarray] = []
+    for t in sorted(lora):
+        for leaf in ("a", "b"):
+            manifest.append(f"{t}/{leaf}")
+            arrays.append(lora[t][leaf])
+    return manifest, arrays
+
+
+def lora_from_list(manifest: Sequence[str], arrays: Sequence) -> Params:
+    if len(manifest) != len(arrays):
+        raise ValueError(
+            f"lora manifest has {len(manifest)} entries, {len(arrays)} arrays")
+    tree: Params = {}
+    for name, arr in zip(manifest, arrays):
+        t, leaf = name.split("/", 1)
+        if leaf not in ("a", "b"):
+            raise ValueError(f"bad lora manifest entry {name!r}")
+        tree.setdefault(t, {})[leaf] = jnp.asarray(arr)
+    for t, ab in tree.items():
+        if set(ab) != {"a", "b"}:
+            raise ValueError(f"lora target {t!r} missing a/b pair")
+    return tree

@@ -732,7 +732,8 @@ class StageExecutor:
     def _train_fns(self, a: int, b: int):
         """Jitted (forward, backward) for blocks [a, b) of the loaded span.
         Stateless: no KV, no session; frozen span weights; grads flow to
-        inputs (+ prompts — jit re-specializes per prompts shape/None)."""
+        inputs (+ prompts and LoRA adapters — jit re-specializes per
+        prompts/lora shape/None; lora_scale is static per compile)."""
         key = ("train", a, b)
         entry = self._subspans.get(key)
         if entry is not None:
@@ -743,19 +744,24 @@ class StageExecutor:
         else:
             layers = jax.tree.map(lambda x: x[a:b], self.params["layers"])
 
-        def f(x, prompts):
+        def f(x, prompts, lora, lora_scale):
+            from ..models.lora import merge_lora
+
             bsz, t, _ = x.shape
             positions = jnp.broadcast_to(
                 jnp.arange(t, dtype=jnp.int32)[None, :], (bsz, t)
             )
-            return stack_forward_train(cfg, layers, x, positions,
-                                       prompts=prompts)
+            return stack_forward_train(
+                cfg, merge_lora(cfg, layers, lora, lora_scale), x, positions,
+                prompts=prompts)
 
-        fwd = jax.jit(f)
+        fwd = jax.jit(f, static_argnums=3)
 
-        @jax.jit
-        def bwd(x, prompts, grad_out):
-            _, vjp = jax.vjp(f, x, prompts)
+        @partial(jax.jit, static_argnums=3)
+        def bwd(x, prompts, lora, lora_scale, grad_out):
+            _, vjp = jax.vjp(
+                lambda x_, p_, l_: f(x_, p_, l_, lora_scale),
+                x, prompts, lora)
             return vjp(grad_out.astype(x.dtype))
 
         entry = (fwd, bwd)
@@ -779,19 +785,46 @@ class StageExecutor:
             raise StageExecutionError(
                 f"prompts cover {prompts.shape[0]} layers, request spans {b - a}"
             )
-        return a, b, x, prompts
+        lora = req.lora
+        if lora:
+            attn = self.params["layers"].get("attn", {})
+            from ..models.quant import is_quantized
+
+            if is_quantized(attn):
+                # merge_lora adds deltas to the stored weights, which for a
+                # --quant span are packed QuantizedTensors (dequantized only
+                # inside the layer scan) — fail as a clean stage error, not
+                # a TypeError the client misreads as a dead peer.
+                raise StageExecutionError(
+                    "LoRA training is unsupported on a quantized span "
+                    "(serve this span unquantized to fine-tune against it)")
+            for t, ab in lora.items():
+                if t not in attn and not (
+                        "wqkv" in attn and t in ("wq", "wk", "wv")):
+                    raise StageExecutionError(
+                        f"LoRA target {t!r} not in this span's attn params")
+                for leaf in ("a", "b"):
+                    arr = ab.get(leaf)
+                    if arr is None or arr.shape[0] != b - a:
+                        raise StageExecutionError(
+                            f"LoRA {t}/{leaf} covers "
+                            f"{None if arr is None else arr.shape[0]} layers, "
+                            f"request spans {b - a}")
+        else:
+            lora = None
+        return a, b, x, prompts, lora
 
     def train_forward(self, req: StageRequest) -> StageResponse:
         """Cache-free span forward of the BLOCKS only (no head/sampling) —
         the training rpc_forward. Sequence padded to the shared buckets so an
         epoch of varying lengths stays within a handful of compiles."""
-        a, b, x, prompts = self._train_args(req)
+        a, b, x, prompts, lora = self._train_args(req)
         fwd, _ = self._train_fns(a, b)
         t_real = req.seq_len
         tb = round_to_bucket(t_real, SEQ_BUCKETS)
         if tb != t_real:
             x = jnp.pad(x, ((0, 0), (0, tb - t_real), (0, 0)))
-        out = fwd(x, prompts)
+        out = fwd(x, prompts, lora, float(req.lora_scale))
         self.requests_served += 1
         return StageResponse(
             session_id=req.session_id, hidden=out[:, :t_real], cache_len=0
@@ -802,7 +835,7 @@ class StageExecutor:
         (grad_input, grad_prompts). Activations are recomputed, never stored
         between training RPCs — same contract as the reference's
         ``run_rpc_backward`` re-forward (block_functions.py:106-124)."""
-        a, b, x, prompts = self._train_args(req)
+        a, b, x, prompts, lora = self._train_args(req)
         g = jnp.asarray(req.grad_output)
         if g.shape != x.shape:
             raise StageExecutionError(
@@ -815,12 +848,13 @@ class StageExecutor:
             pad = ((0, 0), (0, tb - t_real), (0, 0))
             x = jnp.pad(x, pad)
             g = jnp.pad(g, pad)  # zero cotangents on padding
-        gx, gp = bwd(x, prompts, g)
+        gx, gp, gl = bwd(x, prompts, lora, float(req.lora_scale), g)
         self.requests_served += 1
         return BackwardResponse(
             session_id=req.session_id,
             grad_input=gx[:, :t_real],
             grad_prompts=gp,
+            grad_lora=gl,
         )
 
     # ------------------------------------------------------------------

@@ -51,11 +51,15 @@ class _HopFailed(Exception):
 
 
 class DistributedFineTuner:
-    """Deep-prompt-tune a model whose blocks are served by remote peers.
+    """Deep-prompt-tune (and LoRA-tune) a model whose blocks are served by
+    remote peers.
 
-    trainables: always ``prompts`` [num_layers, pre_seq, D]; optionally the
-    embedding and/or head (tiny next to the frozen remote blocks — the same
-    client-side-trainables split as Petals fine-tuning).
+    trainables: always ``prompts`` [num_layers, pre_seq, D]; with
+    ``lora_rank > 0`` also client-owned LoRA adapters over every block
+    (models.lora — shipped per-hop with each training RPC, servers stay
+    frozen and stateless); optionally the embedding and/or head (tiny next
+    to the frozen remote blocks — the same client-side-trainables split as
+    Petals fine-tuning, extended beyond its prompts-only surface).
     """
 
     def __init__(
@@ -70,6 +74,9 @@ class DistributedFineTuner:
         tune_embed: bool = False,
         tune_head: bool = False,
         prompt_init_scale: float = 0.01,
+        lora_rank: int = 0,
+        lora_alpha: float = 16.0,
+        lora_targets=None,
         seed: int = 0,
     ):
         self.cfg = cfg
@@ -79,6 +86,8 @@ class DistributedFineTuner:
         self.weight_decay = weight_decay
         self.tune_embed = tune_embed
         self.tune_head = tune_head
+        self.lora_rank = lora_rank
+        self.lora_scale = (lora_alpha / lora_rank) if lora_rank else 0.0
 
         s0_params = client.stage0.params
         if "embed" not in s0_params:
@@ -93,6 +102,15 @@ class DistributedFineTuner:
             jax.random.PRNGKey(seed), (cfg.num_layers, pre_seq, d), jnp.float32
         )
         self.trainables: Params = {"prompts": prompts}
+        if lora_rank > 0:
+            # Client-owned LoRA adapters over EVERY block (models.lora):
+            # per-hop slices ship with each training RPC like the prompt
+            # slices; the local span merges its slice client-side.
+            from ..models.lora import DEFAULT_TARGETS, init_lora
+
+            self.trainables["lora"] = init_lora(
+                jax.random.PRNGKey(seed + 1), cfg, cfg.num_layers,
+                lora_rank, targets=lora_targets or DEFAULT_TARGETS)
         if tune_embed:
             self.trainables["embed"] = jax.tree.map(
                 jnp.asarray, self._frozen_embed
@@ -142,8 +160,16 @@ class DistributedFineTuner:
             local_prompts = jax.lax.slice_in_dim(
                 tr["prompts"], 0, self.s0_end, axis=0
             )
+            layers = self._local_layers
+            if "lora" in tr:
+                from ..models.lora import merge_lora, slice_lora
+
+                layers = merge_lora(
+                    self.cfg, layers,
+                    slice_lora(tr["lora"], 0, self.s0_end),
+                    self.lora_scale)
             x = stack_forward_train(
-                self.cfg, self._local_layers, x, positions,
+                self.cfg, layers, x, positions,
                 prompts=local_prompts,
             )
         return x
@@ -155,8 +181,16 @@ class DistributedFineTuner:
 
     # -- remote hops --------------------------------------------------------
 
+    def _hop_lora(self, tr: Params, hop) -> Optional[Params]:
+        if "lora" not in tr:
+            return None
+        from ..models.lora import slice_lora
+
+        return slice_lora(tr["lora"], hop.start_block, hop.end_block)
+
     def _remote_forward(self, hops, h: jnp.ndarray, seq_len: int,
-                        prompts: jnp.ndarray, session_id: str):
+                        prompts: jnp.ndarray, session_id: str,
+                        tr: Params):
         """Returns (final hidden, per-hop span inputs)."""
         inputs: List[np.ndarray] = []
         for hop in hops:
@@ -165,6 +199,7 @@ class DistributedFineTuner:
                 session_id=session_id, hidden=h, seq_len=seq_len, cur_len=0,
                 is_prefill=False, max_length=0, train=True,
                 prompts=prompts[hop.start_block:hop.end_block],
+                lora=self._hop_lora(tr, hop), lora_scale=self.lora_scale,
                 start_block=hop.start_block, end_block=hop.end_block,
             )
             try:
@@ -179,15 +214,18 @@ class DistributedFineTuner:
         return h, inputs
 
     def _remote_backward(self, hops, inputs, grad_out: jnp.ndarray,
-                         seq_len: int, prompts: jnp.ndarray, session_id: str):
+                         seq_len: int, prompts: jnp.ndarray, session_id: str,
+                         tr: Params):
         """Reversed hop walk; returns (grad into local output, prompt grad
-        updates [(start, end, grad)])."""
+        updates [(start, end, grad)], lora grad updates [(start, end, tree)])."""
         prompt_grads = []
+        lora_grads = []
         for hop, h_in in zip(reversed(hops), reversed(inputs)):
             breq = BackwardRequest(
                 session_id=session_id, hidden=jnp.asarray(h_in),
                 grad_output=grad_out, seq_len=seq_len,
                 prompts=prompts[hop.start_block:hop.end_block],
+                lora=self._hop_lora(tr, hop), lora_scale=self.lora_scale,
                 start_block=hop.start_block, end_block=hop.end_block,
             )
             try:
@@ -204,7 +242,50 @@ class DistributedFineTuner:
                     (hop.start_block, hop.end_block,
                      jnp.asarray(bresp.grad_prompts))
                 )
-        return grad_out, prompt_grads
+            if bresp.grad_lora:
+                lora_grads.append(
+                    (hop.start_block, hop.end_block, bresp.grad_lora))
+            elif "lora" in self.trainables:
+                # We shipped adapters but got no adapter grads back: a
+                # pre-LoRA peer silently dropped the trailing tensors and
+                # computed the UNADAPTED span — continuing would train
+                # against the wrong model with zero grads for this slice.
+                # Blame the peer so retry routes around it (a newer replica
+                # may serve the same span); all-old swarms fail the step
+                # loudly instead of silently diverging.
+                self._mark_failed(
+                    hop, RuntimeError(
+                        "peer returned no LoRA grads (pre-LoRA version?)"))
+                raise _HopFailed
+        return grad_out, prompt_grads, lora_grads
+
+    # -- adapter checkpointing ---------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Write trainables + optimizer state to one .npz (keyed by tree
+        path). The frozen blocks live with the servers; this file IS the
+        fine-tune — a few MB for prompts + adapters."""
+        flat = {}
+        for kp, leaf in jax.tree_util.tree_leaves_with_path(
+                {"trainables": self.trainables, "opt": self.opt_state}):
+            flat[jax.tree_util.keystr(kp)] = np.asarray(leaf)
+        flat["__steps__"] = np.asarray(self.steps)
+        np.savez(path, **flat)
+
+    def restore(self, path: str) -> None:
+        """Inverse of `save`; the tuner must be constructed with the same
+        config (pre_seq/rank/targets) so tree structures match."""
+        data = np.load(path)
+
+        def load(tree):
+            return jax.tree_util.tree_map_with_path(
+                lambda kp, leaf: jnp.asarray(data[jax.tree_util.keystr(kp)]),
+                tree)
+
+        state = load({"trainables": self.trainables, "opt": self.opt_state})
+        self.trainables = state["trainables"]
+        self.opt_state = state["opt"]
+        self.steps = int(data["__steps__"])
 
     def _mark_failed(self, hop, exc) -> None:
         self.client.failed_peers.setdefault(hop.key, set()).add(hop.peer_id)
@@ -249,13 +330,13 @@ class DistributedFineTuner:
         h0 = self._local_fwd(tr, ids)
         # 2. remote span forwards
         h_last, inputs = self._remote_forward(
-            hops, h0, seq_len, tr["prompts"], session_id
+            hops, h0, seq_len, tr["prompts"], session_id, tr
         )
         # 3. local head + loss
         loss, (g_tr_head, g_h) = self._head_vag(tr, h_last, targets)
         # 4. remote backward chain
-        g_h0, prompt_grads = self._remote_backward(
-            hops, inputs, g_h, seq_len, tr["prompts"], session_id
+        g_h0, prompt_grads, lora_grads = self._remote_backward(
+            hops, inputs, g_h, seq_len, tr["prompts"], session_id, tr
         )
         # 5. local backward + grad assembly
         g_tr_0 = self._local_bwd(tr, ids, g_h0.astype(h0.dtype))
@@ -264,6 +345,12 @@ class DistributedFineTuner:
         for start, end, g in prompt_grads:
             gp = gp.at[start:end].add(g)
         grads["prompts"] = gp
+        for start, end, gtree in lora_grads:
+            for t, ab in gtree.items():
+                for leaf in ("a", "b"):
+                    grads["lora"][t][leaf] = (
+                        grads["lora"][t][leaf]
+                        .at[start:end].add(ab[leaf]))
 
         self.trainables, self.opt_state = adamw_update(
             grads, self.opt_state, tr, lr=self.lr,

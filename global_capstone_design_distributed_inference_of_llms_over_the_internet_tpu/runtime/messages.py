@@ -58,6 +58,12 @@ class StageRequest:
     # each block of the span adds its prompt at absolute positions <
     # pre_seq before computing (executor._get_prompt_step).
     prompts: Optional[jnp.ndarray] = None
+    # Client-owned LoRA adapters for the span (models.lora; train=True
+    # only): {"wq": {"a": [span, D, r], "b": [span, r, O]}, ...}. The
+    # server merges W + lora_scale * a @ b functionally per step and
+    # returns adapter grads — stateless, like the prompt slices.
+    lora: Optional[dict] = None
+    lora_scale: float = 1.0
     # Session rewind (the ``start_from_position`` of petals
     # ``handler.py:163-168`` / ``block_functions.py:163-168``): before this
     # step, shrink the session's valid KV prefix to this position — the
@@ -116,6 +122,10 @@ class BackwardRequest:
     prompts: Optional[jnp.ndarray] = None   # [span_layers, pre_seq, D]
     start_block: Optional[int] = None
     end_block: Optional[int] = None
+    # LoRA adapters, same layout/semantics as StageRequest.lora — the
+    # backward re-forwards with them merged and returns their grads.
+    lora: Optional[dict] = None
+    lora_scale: float = 1.0
 
 
 @dataclasses.dataclass
@@ -123,6 +133,7 @@ class BackwardResponse:
     session_id: str
     grad_input: jnp.ndarray                   # [B, T, D]
     grad_prompts: Optional[jnp.ndarray] = None  # [span_layers, pre_seq, D]
+    grad_lora: Optional[dict] = None            # same tree shape as lora
 
 
 @dataclasses.dataclass

@@ -694,6 +694,9 @@ class TcpStageServer(_FramedTcpServer):
                 "requests_served": ex.requests_served,
                 "engine": getattr(ex, "engine", "session"),
                 "version": 1,
+                # Capability flags for mixed-version swarms (the data-plane
+                # guard is the client's no-grad_lora check in finetune).
+                "lora": True,
             }
             # Batched engines expose their coalescing effectiveness (rounds
             # executed vs requests served) for tests + ops introspection.
@@ -930,6 +933,19 @@ class TcpStageServer(_FramedTcpServer):
         # QoS via the pool kinds: inference outranks both training verbs
         # (DummyTaskPrioritizer semantics, petals/server/task_prioritizer.py).
         tensors = _decode_tensors(header["tensors"], payload)
+        # LoRA adapters trail the frame; peel them off by manifest length
+        # (header-driven — the positional prompts convention predates it,
+        # so has_prompts falls back to arity for legacy clients).
+        manifest = header.get("lora_manifest")
+        lora = None
+        if manifest:
+            from ..models.lora import lora_from_list
+
+            lora = lora_from_list(manifest, tensors[-len(manifest):])
+            tensors = tensors[:-len(manifest)]
+        lora_scale = float(header.get("lora_scale", 1.0))
+        base = 1 if verb == "train_forward" else 2
+        has_prompts = header.get("has_prompts", len(tensors) > base)
         try:
             if verb == "train_forward":
                 req = StageRequest(
@@ -938,7 +954,8 @@ class TcpStageServer(_FramedTcpServer):
                     seq_len=header["seq_len"], cur_len=0, is_prefill=False,
                     max_length=0, train=True,
                     prompts=(jnp.asarray(tensors[1])
-                             if len(tensors) > 1 else None),
+                             if has_prompts else None),
+                    lora=lora, lora_scale=lora_scale,
                     start_block=header.get("start_block"),
                     end_block=header.get("end_block"),
                 )
@@ -957,7 +974,8 @@ class TcpStageServer(_FramedTcpServer):
                     grad_output=jnp.asarray(tensors[1]),
                     seq_len=header["seq_len"],
                     prompts=(jnp.asarray(tensors[2])
-                             if len(tensors) > 2 else None),
+                             if has_prompts else None),
+                    lora=lora, lora_scale=lora_scale,
                     start_block=header.get("start_block"),
                     end_block=header.get("end_block"),
                 )
@@ -966,11 +984,16 @@ class TcpStageServer(_FramedTcpServer):
                 arrs = [np.asarray(bresp.grad_input)]
                 if bresp.grad_prompts is not None:
                     arrs.append(np.asarray(bresp.grad_prompts))
+                hdr_out = {"verb": "grads", "session_id": bresp.session_id}
+                if bresp.grad_lora:
+                    from ..models.lora import lora_to_list
+
+                    gmanifest, garrs = lora_to_list(bresp.grad_lora)
+                    hdr_out["lora_manifest"] = gmanifest
+                    arrs += [np.asarray(a) for a in garrs]
                 metas, body = _encode_tensors(arrs, "f32")
-                _send_frame(sock, {
-                    "verb": "grads", "session_id": bresp.session_id,
-                    "tensors": metas,
-                }, body)
+                hdr_out["tensors"] = metas
+                _send_frame(sock, hdr_out, body)
         except (StageExecutionError, TaskRejected) as exc:
             _send_frame(sock, {"verb": "error", "message": str(exc),
                                "kind": "stage"})
@@ -1118,22 +1141,31 @@ class TcpTransport(Transport):
                 arrs = [np.asarray(request.hidden)]
                 # Per-tensor schema (petals handler.py:411-432): the
                 # activation rides the session wire dtype; learned PROMPTS
-                # stay f32 — they are trainable parameters, and bf16-
-                # rounding them on every step would quantize the tuning
-                # signal itself.
+                # and LoRA adapters stay f32 — they are trainable
+                # parameters, and bf16-rounding them on every step would
+                # quantize the tuning signal itself.
                 wds = [self.wire_dtype]
                 if request.prompts is not None:
                     arrs.append(np.asarray(request.prompts))
                     wds.append("f32")
-                metas, body = _encode_tensors(arrs, wds)
                 hdr = {
                     "verb": "train_forward",
                     "session_id": request.session_id,
                     "seq_len": request.seq_len,
                     "start_block": request.start_block,
                     "end_block": request.end_block,
-                    "tensors": metas,
+                    "has_prompts": request.prompts is not None,
                 }
+                if request.lora:
+                    from ..models.lora import lora_to_list
+
+                    manifest, lora_arrs = lora_to_list(request.lora)
+                    hdr["lora_manifest"] = manifest
+                    hdr["lora_scale"] = float(request.lora_scale)
+                    arrs += [np.asarray(a) for a in lora_arrs]
+                    wds += ["f32"] * len(lora_arrs)
+                metas, body = _encode_tensors(arrs, wds)
+                hdr["tensors"] = metas
                 _send_frame(sock, self._tagged(hdr), body)
             elif request.prompts is not None:
                 # Deep-prompt inference step: prompts ride as a second
@@ -1327,15 +1359,23 @@ class TcpTransport(Transport):
             arrs = [np.asarray(request.hidden), np.asarray(request.grad_output)]
             if request.prompts is not None:
                 arrs.append(np.asarray(request.prompts))
-            metas, body = _encode_tensors(arrs, "f32")
             hdr = {
                 "verb": "backward",
                 "session_id": request.session_id,
                 "seq_len": request.seq_len,
                 "start_block": request.start_block,
                 "end_block": request.end_block,
-                "tensors": metas,
+                "has_prompts": request.prompts is not None,
             }
+            if request.lora:
+                from ..models.lora import lora_to_list
+
+                manifest, lora_arrs = lora_to_list(request.lora)
+                hdr["lora_manifest"] = manifest
+                hdr["lora_scale"] = float(request.lora_scale)
+                arrs += [np.asarray(a) for a in lora_arrs]
+            metas, body = _encode_tensors(arrs, "f32")
+            hdr["tensors"] = metas
             _send_frame(sock, self._tagged(hdr), body)
             header, payload = _recv_frame(sock)
         except socket.timeout as exc:
@@ -1346,11 +1386,20 @@ class TcpTransport(Transport):
             raise PeerUnavailable(f"peer {peer_id} connection failed: {exc}")
         if header.get("verb") == "grads":
             tensors = _decode_tensors(header["tensors"], payload)
+            n_lora = len(header.get("lora_manifest", ()))
+            grad_lora = None
+            if n_lora:
+                from ..models.lora import lora_from_list
+
+                grad_lora = lora_from_list(header["lora_manifest"],
+                                           tensors[-n_lora:])
+                tensors = tensors[:-n_lora]
             return BackwardResponse(
                 session_id=header["session_id"],
                 grad_input=jnp.asarray(tensors[0]),
                 grad_prompts=(jnp.asarray(tensors[1])
                               if len(tensors) > 1 else None),
+                grad_lora=grad_lora,
             )
         if header.get("verb") == "error":
             if header.get("kind") == "stage":
