@@ -2,6 +2,7 @@ from .config import (
     ModelConfig,
     PRESETS,
     get_config,
+    gemma2_config,
     gemma_config,
     gpt2_config,
     llama_config,
@@ -21,7 +22,8 @@ from .transformer import (
 from .hf_import import config_from_hf, convert_state_dict, import_hf_model
 
 __all__ = [
-    "ModelConfig", "PRESETS", "get_config", "gemma_config", "gpt2_config",
+    "ModelConfig", "PRESETS", "get_config", "gemma2_config", "gemma_config",
+    "gpt2_config",
     "llama_config", "mistral_config", "mixtral_config", "qwen2_config",
     "embed_tokens",
     "full_forward",

@@ -64,6 +64,23 @@ class ModelConfig:
     # Multiply token embeddings by sqrt(hidden_size) (Gemma "normalizer").
     embed_scale: bool = False
 
+    # Gemma-2 switches:
+    # Sandwich norms: each sublayer output passes a POST-norm before the
+    # residual add (ln3 after attention, ln4 after the MLP).
+    post_norms: bool = False
+    # Logit softcapping, cap * tanh(x / cap): on attention scores pre-mask
+    # (attn) and on the LM head output (final). 0 = off.
+    attn_softcap: float = 0.0
+    final_softcap: float = 0.0
+    # Attention score scale override (query_pre_attn_scalar ** -0.5);
+    # 0 = the usual head_dim ** -0.5.
+    query_scale: float = 0.0
+    # Alternating local/global attention: EVEN layer indices use this
+    # sliding window, odd layers attend globally (HF Gemma2 layout). The
+    # per-layer window rides the layer param tree as a "window" leaf so
+    # every engine's layer scan sees it. 0 = off.
+    altern_window: int = 0
+
     @property
     def head_dim(self) -> int:
         return (self.head_dim_override
@@ -169,6 +186,21 @@ def gemma_config(head_dim: int = 256, norm_eps: float = 1e-6,
         head_dim_override=head_dim, norm_offset=True, embed_scale=True)
 
 
+def gemma2_config(head_dim: int = 256, query_pre_attn_scalar: float = 0.0,
+                  attn_softcap: float = 50.0, final_softcap: float = 30.0,
+                  sliding_window: int = 4096, **kw) -> ModelConfig:
+    """Gemma 2: the Gemma skeleton plus sandwich (pre+post) norms, attention
+    and final-logit softcapping, alternating local/global attention (even
+    layers windowed), and an optional query_pre_attn_scalar score scale."""
+    cfg = gemma_config(head_dim=head_dim, **kw)
+    return dataclasses.replace(
+        cfg, model_type="gemma2", post_norms=True,
+        attn_softcap=attn_softcap, final_softcap=final_softcap,
+        query_scale=(query_pre_attn_scalar ** -0.5
+                     if query_pre_attn_scalar else 0.0),
+        altern_window=sliding_window)
+
+
 def mixtral_config(num_experts: int = 8, num_experts_per_tok: int = 2, **kw) -> ModelConfig:
     cfg = llama_config(**kw)
     return dataclasses.replace(
@@ -217,6 +249,16 @@ PRESETS = {
         num_kv_heads=16, intermediate_size=24576,
         max_position_embeddings=8192,
     ),
+    "gemma-2-2b": lambda: gemma2_config(
+        vocab_size=256000, hidden_size=2304, num_layers=26, num_heads=8,
+        num_kv_heads=4, intermediate_size=9216,
+        max_position_embeddings=8192, query_pre_attn_scalar=256.0,
+    ),
+    "gemma-2-9b": lambda: gemma2_config(
+        vocab_size=256000, hidden_size=3584, num_layers=42, num_heads=16,
+        num_kv_heads=8, intermediate_size=14336,
+        max_position_embeddings=8192, query_pre_attn_scalar=256.0,
+    ),
     "qwen2-0.5b": lambda: qwen2_config(
         vocab_size=151936, hidden_size=896, num_layers=24, num_heads=14,
         num_kv_heads=2, intermediate_size=4864, max_position_embeddings=32768,
@@ -228,6 +270,19 @@ PRESETS = {
         rope_theta=1000000.0,
     ),
 }
+
+
+def custom_engine_unsupported(cfg: ModelConfig) -> Optional[str]:
+    """Reason the engines that RE-IMPLEMENT the layer body (batched slots,
+    sequence-parallel ring, TP shard specs) cannot serve this config, or
+    None. The gemma2 semantics live in models.transformer.layer_forward,
+    which the session/fused/oracle engines share; engines with their own
+    attention math must refuse rather than silently drop them."""
+    if (cfg.post_norms or cfg.attn_softcap or cfg.query_scale
+            or cfg.altern_window):
+        return ("gemma2 semantics (sandwich norms / softcap / per-layer "
+                "window) are not implemented on this engine")
+    return None
 
 
 def get_config(name: str) -> ModelConfig:

@@ -145,6 +145,19 @@ def config_from_hf(hf_cfg) -> ModelConfig:
         # to the tanh approximation here. norm_eps/tie_word_embeddings ride
         # in via `common` (GemmaConfig always defines both attributes).
         return gemma_config(head_dim=hf_cfg.head_dim, **common)
+    if mt == "gemma2":
+        from .config import gemma2_config
+
+        return gemma2_config(
+            head_dim=hf_cfg.head_dim,
+            query_pre_attn_scalar=float(
+                getattr(hf_cfg, "query_pre_attn_scalar", 0.0) or 0.0),
+            attn_softcap=float(
+                getattr(hf_cfg, "attn_logit_softcapping", 0.0) or 0.0),
+            final_softcap=float(
+                getattr(hf_cfg, "final_logit_softcapping", 0.0) or 0.0),
+            sliding_window=int(getattr(hf_cfg, "sliding_window", 0) or 0),
+            **common)
     if mt == "mixtral":
         cfg = mixtral_config(
             num_experts=hf_cfg.num_local_experts,
@@ -191,7 +204,6 @@ def _llama_layer(sd: Mapping[str, Any], i: int, cfg: ModelConfig) -> Params:
     pre = f"model.layers.{i}."
     p: Params = {
         "ln1": {"w": _np(sd[pre + "input_layernorm.weight"])},
-        "ln2": {"w": _np(sd[pre + "post_attention_layernorm.weight"])},
         "attn": {
             "wq": _np(sd[pre + "self_attn.q_proj.weight"]).T,
             "wk": _np(sd[pre + "self_attn.k_proj.weight"]).T,
@@ -199,6 +211,19 @@ def _llama_layer(sd: Mapping[str, Any], i: int, cfg: ModelConfig) -> Params:
             "wo": _np(sd[pre + "self_attn.o_proj.weight"]).T,
         },
     }
+    if cfg.post_norms:
+        # gemma2 sandwich norms: HF's "post_attention_layernorm" is the
+        # POST-attn norm (our ln3); the pre-MLP norm is
+        # "pre_feedforward_layernorm" (our ln2).
+        p["ln2"] = {"w": _np(sd[pre + "pre_feedforward_layernorm.weight"])}
+        p["ln3"] = {"w": _np(sd[pre + "post_attention_layernorm.weight"])}
+        p["ln4"] = {"w": _np(sd[pre + "post_feedforward_layernorm.weight"])}
+    else:
+        p["ln2"] = {"w": _np(sd[pre + "post_attention_layernorm.weight"])}
+    if cfg.altern_window:
+        # even layers windowed, odd global (HF Gemma2Attention layer_idx
+        # rule) — the traced per-layer window leaf.
+        p["window"] = np.int32(cfg.altern_window if i % 2 == 0 else 0)
     if cfg.attn_qkv_bias:  # qwen2: q/k/v biases, no o bias
         p["attn"]["bq"] = _np(sd[pre + "self_attn.q_proj.bias"])
         p["attn"]["bk"] = _np(sd[pre + "self_attn.k_proj.bias"])

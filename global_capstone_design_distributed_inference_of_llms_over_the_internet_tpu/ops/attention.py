@@ -120,13 +120,22 @@ def cached_attention(
     v_cache: jnp.ndarray,
     cache_len: jnp.ndarray,
     *,
-    sliding_window: Optional[int] = None,
+    sliding_window=None,
+    scale: float = 0.0,
+    logit_softcap: float = 0.0,
 ) -> jnp.ndarray:
     """Causal attention of T query tokens over a cache holding cache_len+T keys.
 
     q: [B, T, H, Dh] — query i has absolute position cache_len + i.
     k_cache/v_cache: [B, S, Hkv, Dh] with the new keys already written.
     Returns [B, T, H, Dh].
+
+    sliding_window may be a static int OR a traced int32 scalar (the
+    per-layer "window" leaf of alternating local/global models riding a
+    layer scan); a value <= 0 disables the window, so one compiled body
+    serves both layer kinds. scale overrides the head_dim ** -0.5 score
+    scale (gemma2 query_pre_attn_scalar); logit_softcap > 0 applies
+    cap * tanh(s / cap) to scores before masking (gemma2).
 
     Right-padded prefill is safe: a real query at position i only attends to
     keys j <= cache_len + i, all of which are real tokens; padded queries
@@ -139,19 +148,22 @@ def cached_attention(
     # Keep cache operands in their storage dtype (bf16 on TPU) — converting the
     # whole [B,S,Hkv,Dh] cache to fp32 would double HBM traffic per decode
     # step. fp32 accumulation comes from preferred_element_type instead.
-    q = q * (dh ** -0.5)
+    q = q * (scale if scale else dh ** -0.5)
 
     # [B, T, Hkv, G, Dh] x [B, S, Hkv, Dh] -> [B, Hkv, G, T, S]
     qg = q.reshape(b, t, hkv, groups, dh)
     scores = jnp.einsum(
         "bthgd,bshd->bhgts", qg, k_cache, preferred_element_type=jnp.float32
     )
+    if logit_softcap:
+        scores = logit_softcap * jnp.tanh(scores / logit_softcap)
 
     q_pos = cache_len + jnp.arange(t, dtype=jnp.int32)  # [T]
     k_pos = jnp.arange(s, dtype=jnp.int32)  # [S]
     allowed = k_pos[None, :] <= q_pos[:, None]  # causal
     if sliding_window is not None:
-        allowed &= k_pos[None, :] > (q_pos[:, None] - sliding_window)
+        w = jnp.asarray(sliding_window, jnp.int32)
+        allowed &= (k_pos[None, :] > (q_pos[:, None] - w)) | (w <= 0)
     scores = jnp.where(allowed[None, None, None, :, :], scores, NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)

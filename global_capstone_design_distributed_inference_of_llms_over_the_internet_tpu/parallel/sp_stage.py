@@ -114,6 +114,11 @@ class SpStageRunner:
     ):
         if cfg.sliding_window:
             raise ValueError("sp serving is causal-only (no sliding window)")
+        from ..models.config import custom_engine_unsupported
+
+        reason = custom_engine_unsupported(cfg)
+        if reason:
+            raise ValueError(f"sp engine: {reason}")
         self.cfg = cfg
         self.spec = spec
         self.mesh = mesh

@@ -110,6 +110,14 @@ def shard_stage_params(
 
 
 def validate_tp(cfg: ModelConfig, tp: int) -> None:
+    from ..models.config import custom_engine_unsupported
+
+    reason = custom_engine_unsupported(cfg)
+    if reason:
+        # stage_forward would compute correctly, but the param-spec table
+        # has no layout for the per-layer window leaf and the softcap has
+        # no shard_map test coverage — refuse until implemented.
+        raise ValueError(f"tensor parallelism: {reason}")
     if cfg.num_heads % tp:
         raise ValueError(f"num_heads {cfg.num_heads} % tp {tp} != 0")
     if cfg.num_kv_heads % tp:

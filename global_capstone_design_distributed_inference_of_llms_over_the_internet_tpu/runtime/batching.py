@@ -75,6 +75,11 @@ class BatchedStageExecutor:
         max_len: int = 2048,
         dtype=jnp.float32,
     ):
+        from ..models.config import custom_engine_unsupported
+
+        reason = custom_engine_unsupported(cfg)
+        if reason:
+            raise ValueError(f"batched engine: {reason}")
         self.cfg = cfg
         self.spec = spec
         # Engine-side fused-QKV layout (one projection matmul per layer,

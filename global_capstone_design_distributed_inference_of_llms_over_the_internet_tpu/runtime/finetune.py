@@ -265,6 +265,10 @@ class DistributedFineTuner:
         """Write trainables + optimizer state to one .npz (keyed by tree
         path). The frozen blocks live with the servers; this file IS the
         fine-tune — a few MB for prompts + adapters."""
+        if not path.endswith(".npz"):
+            # np.savez appends the suffix silently; normalize so restore
+            # (np.load, which does not) finds the same file.
+            path += ".npz"
         flat = {}
         for kp, leaf in jax.tree_util.tree_leaves_with_path(
                 {"trainables": self.trainables, "opt": self.opt_state}):
@@ -275,6 +279,8 @@ class DistributedFineTuner:
     def restore(self, path: str) -> None:
         """Inverse of `save`; the tuner must be constructed with the same
         config (pre_seq/rank/targets) so tree structures match."""
+        if not path.endswith(".npz"):
+            path += ".npz"
         data = np.load(path)
 
         def load(tree):

@@ -933,20 +933,26 @@ class TcpStageServer(_FramedTcpServer):
         # QoS via the pool kinds: inference outranks both training verbs
         # (DummyTaskPrioritizer semantics, petals/server/task_prioritizer.py).
         tensors = _decode_tensors(header["tensors"], payload)
-        # LoRA adapters trail the frame; peel them off by manifest length
-        # (header-driven — the positional prompts convention predates it,
-        # so has_prompts falls back to arity for legacy clients).
-        manifest = header.get("lora_manifest")
-        lora = None
-        if manifest:
-            from ..models.lora import lora_from_list
-
-            lora = lora_from_list(manifest, tensors[-len(manifest):])
-            tensors = tensors[:-len(manifest)]
-        lora_scale = float(header.get("lora_scale", 1.0))
-        base = 1 if verb == "train_forward" else 2
-        has_prompts = header.get("has_prompts", len(tensors) > base)
         try:
+            # LoRA adapters trail the frame; peel them off by manifest
+            # length (header-driven — the positional prompts convention
+            # predates it, so has_prompts falls back to arity for legacy
+            # clients). Inside the try: a malformed manifest must come
+            # back as a clean stage error, not a connection-level one the
+            # client misreads as a dead peer.
+            manifest = header.get("lora_manifest")
+            lora = None
+            if manifest:
+                from ..models.lora import lora_from_list
+
+                try:
+                    lora = lora_from_list(manifest, tensors[-len(manifest):])
+                except ValueError as exc:
+                    raise StageExecutionError(str(exc)) from exc
+                tensors = tensors[:-len(manifest)]
+            lora_scale = float(header.get("lora_scale", 1.0))
+            base = 1 if verb == "train_forward" else 2
+            has_prompts = header.get("has_prompts", len(tensors) > base)
             if verb == "train_forward":
                 req = StageRequest(
                     session_id=header["session_id"],

@@ -730,3 +730,28 @@ def test_client_speculative_sampled_batched_matches_per_session():
                                  slots=4, max_len=64)
     batched = run(BatchingStageAdapter(inner, window_s=0.0, peer_id="peer"))
     assert batched == per_session
+
+
+def test_batched_engine_refuses_gemma2_semantics():
+    """Engines that re-implement the layer body must refuse configs whose
+    semantics live only in models.transformer.layer_forward (gemma2
+    sandwich norms / softcaps / per-layer windows) — silent omission would
+    serve a different model."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+        gemma2_config,
+        init_params,
+    )
+
+    cfg = gemma2_config(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=2, num_kv_heads=1, intermediate_size=64,
+                        head_dim=16, sliding_window=8,
+                        max_position_embeddings=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="gemma2"):
+        BatchedStageExecutor(cfg, full_spec(cfg), params, slots=2, max_len=32)
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.parallel.tensor_parallel import (
+        validate_tp,
+    )
+
+    with pytest.raises(ValueError, match="gemma2"):
+        validate_tp(cfg, 2)

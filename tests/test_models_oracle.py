@@ -93,6 +93,25 @@ def tiny_gemma():
     return GemmaForCausalLM(hf_cfg).eval()
 
 
+def tiny_gemma2():
+    torch.manual_seed(0)
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    # sliding_window=8 with a 16+-token prompt exercises the alternating
+    # local/global layers; softcaps + query_pre_attn_scalar != head_dim
+    # exercise the scoring path.
+    hf_cfg = Gemma2Config(
+        vocab_size=320, hidden_size=64, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=128,
+        head_dim=32, max_position_embeddings=128, rope_theta=10000.0,
+        hidden_activation="gelu_pytorch_tanh", tie_word_embeddings=True,
+        sliding_window=8, query_pre_attn_scalar=16.0,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        attn_implementation="eager",  # softcapping needs the eager path
+    )
+    return Gemma2ForCausalLM(hf_cfg).eval()
+
+
 FACTORIES = {
     "gpt2": tiny_gpt2,
     "llama": tiny_llama,
@@ -100,6 +119,7 @@ FACTORIES = {
     "mixtral": tiny_mixtral,
     "qwen2": tiny_qwen2,
     "gemma": tiny_gemma,
+    "gemma2": tiny_gemma2,
 }
 
 
@@ -128,7 +148,8 @@ def test_prefill_logits_match_hf(family):
     assert (np.asarray(logits).argmax(-1) == ref_logits.argmax(-1)).all()
 
 
-@pytest.mark.parametrize("family", ["gpt2", "llama", "qwen2", "gemma"])
+@pytest.mark.parametrize("family",
+                         ["gpt2", "llama", "qwen2", "gemma", "gemma2"])
 def test_incremental_decode_matches_full_recompute(family):
     """Prefill + per-token decode through the KV cache must equal one full
     forward over the whole sequence (the cache is exact, not approximate)."""
