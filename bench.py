@@ -296,6 +296,104 @@ def bench_prefill(cfg, params, *, batch, seq, n1=8, n2=56, reps=4):
     }
 
 
+def bench_prefix_cache(cfg, params, *, seq=8192, suffix=128, reps=12,
+                       cache_dtype=jnp.bfloat16):
+    """Warm-prefix prefill through the REAL session executor
+    (runtime.prefix_cache): mean wall per prefill with a cold store vs a
+    hot one (shared prefix, distinct suffixes). The stage is the CLIENT
+    entry role (embed + span, stage0) fed int32 token ids — a [1, seq]
+    ids array is ~32 KB on the wire/tunnel, so the measurement is span
+    compute + fixed dispatch, not megabytes of hidden-state transfer (a
+    float-hidden variant of this row was swamped by tunnel H2D variance).
+    Host-driven per-call timing — the per-call dispatch overhead rides
+    BOTH means identically, so the DELTA is the recovered span compute;
+    seq is sized so that compute dwarfs the ±30 ms dispatch noise across
+    reps. Each rep is a fresh session (freed after) with a distinct
+    suffix, so nothing is served from identical-input caches."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.partition import (
+        ROLE_STAGE0,
+        StageSpec,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.executor import (
+        StageExecutor,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.messages import (
+        StageRequest,
+    )
+
+    spec = StageSpec(index=0, role=ROLE_STAGE0, start=0, end=cfg.num_layers)
+    stage_params = {"layers": params["layers"], "embed": params["embed"]}
+    prefix_len = seq - suffix
+    rng = np.random.default_rng(11)
+    base = rng.integers(0, cfg.vocab_size, (1, seq)).astype(np.int32)
+
+    def run(ex, n, mark_prefix, tag):
+        times = []
+        for i in range(n):
+            ids = base.copy()
+            ids[:, prefix_len:] = rng.integers(0, cfg.vocab_size,
+                                               (1, suffix))
+            sid = f"pfx-{tag}-{i}"
+            t0 = time.perf_counter()
+            # Host array in, like the wire path: the store digests the
+            # HOST buffer (no D2H round trip); H2D conversion is identical
+            # for cold and warm.
+            resp = ex.forward(StageRequest(
+                session_id=sid, hidden=ids, seq_len=seq,
+                cur_len=0, is_prefill=True, max_length=seq,
+                prefix_len=prefix_len if mark_prefix else 0))
+            # Close the timing by FETCHING a row that data-depends on the
+            # whole prefill (bench rule 1); the last row attends to
+            # everything before it.
+            np.asarray(resp.hidden[:, -1])
+            times.append(time.perf_counter() - t0)
+            ex.drop_session(sid)
+        return times
+
+    def executor(with_store):
+        return StageExecutor(
+            cfg, spec, stage_params, cache_dtype=cache_dtype,
+            max_cache_bytes=2 << 30,
+            prefix_cache_bytes=(2 << 30) if with_store else 0)
+
+    cold_ex = executor(False)
+    run(cold_ex, 2, False, "warmup")          # compile
+    cold = run(cold_ex, reps, False, "cold")
+    del cold_ex
+
+    warm_ex = executor(True)
+    run(warm_ex, 2, False, "warmup2")         # same compiled shapes
+    run(warm_ex, 1, True, "register")         # miss -> registers the prefix
+    # The hit path runs the suffix at ITS OWN seq bucket — pay that compile
+    # in a discarded rep or the first timed rep carries ~30s of XLA.
+    run(warm_ex, 1, True, "warm-compile")
+    warm = run(warm_ex, reps, True, "warm")
+    stats = warm_ex.prefix_store.stats()
+    del warm_ex
+
+    cold_ms = float(np.mean(cold)) * 1e3
+    warm_ms = float(np.mean(warm)) * 1e3
+    return {
+        "cold_prefill_ms": round(cold_ms, 1),
+        "warm_prefill_ms": round(warm_ms, 1),
+        "warm_speedup": round(cold_ms / warm_ms, 2) if warm_ms else None,
+        "saved_ms_per_prefill": round(cold_ms - warm_ms, 1),
+        "cold_ms_spread": [round(min(cold) * 1e3, 1),
+                           round(max(cold) * 1e3, 1)],
+        "warm_ms_spread": [round(min(warm) * 1e3, 1),
+                           round(max(warm) * 1e3, 1)],
+        "seq": seq, "prefix_len": prefix_len, "suffix": suffix,
+        "store": {k: stats[k] for k in
+                  ("hits", "misses", "grains_reused", "entries")},
+        "note": ("host-driven per-call wall (per-call dispatch overhead "
+                 "INCLUDED in both means — the hit path costs a few extra "
+                 "eager dispatches for the KV copy, so on this tunnel rig "
+                 "each is ~100 ms; seq is sized so recovered span compute "
+                 "dominates) — warm reuses the shared prefix KV via "
+                 "runtime.prefix_cache and computes only the suffix"),
+    }
+
+
 def bench_serving_batched(cfg, params, *, slots=8, max_len=512, prefill=64,
                           rounds=64, reps=2):
     """The SERVING path at full slots: runtime.batching's decode_batch, one
@@ -990,7 +1088,9 @@ def main():
         rs = bench_serving_batched(cfg, params, slots=2, max_len=64,
                                    prefill=8, rounds=8, reps=1)
         rp = bench_prefill(cfg, params, batch=2, seq=32, n1=2, n2=8, reps=1)
-        cfgs = {"smoke": r, "smoke_serving": rs, "smoke_prefill": rp}
+        rpx = bench_prefix_cache(cfg, params, seq=96, suffix=16, reps=2)
+        cfgs = {"smoke": r, "smoke_serving": rs, "smoke_prefill": rp,
+                "smoke_prefix_cache": rpx}
         print(json.dumps({"metric": "smoke", "value": r["tokens_per_s"],
                           "unit": "tokens/s", "vs_baseline": 1.0,
                           "configs": cfgs}))
@@ -1123,6 +1223,14 @@ def main():
             "flagship_1b_b16_nf4", {"error": str(exc)[:200]})
         results.setdefault("flagship_1b_b16_nf4_kernel",
                            {"error": str(exc)[:200]})
+    # Warm-prefix prefill (runtime.prefix_cache): repeat/shared prompt
+    # prefixes skip the span forward; the row measures the recovered
+    # compute through the real session executor.
+    try:
+        results["flagship_prefix_cache_s8192"] = bench_prefix_cache(
+            fcfg, fparams)
+    except Exception as exc:
+        results["flagship_prefix_cache_s8192"] = {"error": str(exc)[:200]}
     del fparams
 
     # BASELINE config #5: microbatched deep-pipeline decode (subprocess on
@@ -1228,6 +1336,8 @@ def _compact_summary(results, primary, vs):
             per_config[name] = row["tokens_per_s"]
         elif "prompt_tokens_per_s" in row:
             per_config[name] = row["prompt_tokens_per_s"]
+        elif "warm_speedup" in row:   # prefix-cache row
+            per_config[name] = row["warm_speedup"]
         elif "work_ratio_measured" in row:
             per_config[name] = row["work_ratio_measured"]
         elif "tick_ms" in row:

@@ -1250,6 +1250,18 @@ def _print_swarm_health(infos: dict, total_servers: int = 0) -> None:
         slows.sort(reverse=True)
         print("  slowest hops: " + ", ".join(
             f"{peer} {d:.1f}ms ({v})" for d, peer, v in slows[:3]))
+    pfx = [(peer, inf["prefix_cache"]) for peer, inf in infos.items()
+           if isinstance(inf.get("prefix_cache"), dict)]
+    if pfx:
+        hits = sum(s.get("hits", 0) for _, s in pfx)
+        misses = sum(s.get("misses", 0) for _, s in pfx)
+        total = hits + misses
+        rate = f"{hits / total:.0%}" if total else "n/a"
+        print(f"  prefix cache: {len(pfx)} server(s), hit rate {rate} "
+              f"({hits}/{total}), "
+              f"{sum(s.get('grains_reused', 0) for _, s in pfx)} grains "
+              f"reused, "
+              f"{sum(s.get('bytes', 0) for _, s in pfx) >> 20} MiB resident")
     pressure = [(inf.get("cache_tokens_left"), peer)
                 for peer, inf in infos.items()
                 if inf.get("cache_tokens_left") is not None]

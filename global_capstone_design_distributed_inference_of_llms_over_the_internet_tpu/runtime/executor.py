@@ -64,6 +64,37 @@ class StageExecutionError(RuntimeError):
     ``src/rpc_handler.py:198-202`` for decode-without-cache)."""
 
 
+_PREFIX_CHAIN_JIT = None
+
+
+def _apply_prefix_chain(k, v, segs_k, segs_v):
+    """Write a prefix-cache chain's KV segments (each [L, B, G, H, Dh])
+    into the leading rows of the session caches in ONE program. Lists are
+    pytrees, so jit re-specializes per chain length — stable per shared
+    prompt. The fresh arena lease is donated (platform-gated like the
+    engines — utils.platform.engine_donation) so a hit updates the
+    bucket-sized buffers in place instead of duplicating them.
+
+    Built LAZILY on first use: evaluating engine_donation at module import
+    would initialize the JAX backend as an import side effect — breaking
+    dcn.initialize's must-run-first contract and freezing the donation
+    decision before a CPU fallback could flip it."""
+    global _PREFIX_CHAIN_JIT
+    if _PREFIX_CHAIN_JIT is None:
+        @partial(jax.jit, donate_argnums=engine_donation(0, 1))
+        def fn(k, v, segs_k, segs_v):
+            zeros = (0,) * k.ndim
+            kc = (segs_k[0] if len(segs_k) == 1
+                  else jnp.concatenate(segs_k, axis=2))
+            vc = (segs_v[0] if len(segs_v) == 1
+                  else jnp.concatenate(segs_v, axis=2))
+            return (jax.lax.dynamic_update_slice(k, kc, zeros),
+                    jax.lax.dynamic_update_slice(v, vc, zeros))
+
+        _PREFIX_CHAIN_JIT = fn
+    return _PREFIX_CHAIN_JIT(k, v, segs_k, segs_v)
+
+
 def verify_drafts_from_logits(
     logits2d: jnp.ndarray, req: StageRequest
 ) -> "tuple[tuple[int, ...], int]":
@@ -542,16 +573,14 @@ class StageExecutor:
                 chain = self.prefix_store.lookup_chain(
                     keys, need_out=not sub_spec.is_last)
                 if chain:
-                    # One buffer-sized update per cache, not one per grain:
-                    # concatenate the chain's segments (cheap — segment-
-                    # sized) and write once at position 0.
-                    zeros = (0,) * handle.k.ndim
-                    kc = (chain[0].k if len(chain) == 1 else
-                          jnp.concatenate([e.k for e in chain], axis=2))
-                    vc = (chain[0].v if len(chain) == 1 else
-                          jnp.concatenate([e.v for e in chain], axis=2))
-                    handle.k = jax.lax.dynamic_update_slice(handle.k, kc, zeros)
-                    handle.v = jax.lax.dynamic_update_slice(handle.v, vc, zeros)
+                    # ONE dispatch applies the whole chain (concat + both
+                    # cache writes inside one jitted program — jit
+                    # specializes per chain length, which is stable for a
+                    # given shared prompt). Eager per-grain updates would
+                    # cost a device round trip each.
+                    handle.k, handle.v = _apply_prefix_chain(
+                        handle.k, handle.v,
+                        [e.k for e in chain], [e.v for e in chain])
                     pfx_outs = [e.out for e in chain if e.out is not None]
                     pfx_skip = len(chain) * grain
                     handle.advance(pfx_skip)

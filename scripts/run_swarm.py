@@ -56,6 +56,9 @@ def main():
                         "engine (--mode serve --batched)")
     p.add_argument("--slots", type=int, default=8,
                    help="--batched: concurrent sessions per server")
+    p.add_argument("--prefix_cache_mb", type=int, default=0,
+                   help="enable each server's prompt-prefix KV store "
+                        "(forwarded to --mode serve)")
     p.add_argument("--tp", type=int, default=1,
                    help="fixed-split servers shard their stage over a "
                         "local ('tp',) mesh of N devices")
@@ -101,6 +104,15 @@ def main():
         procs.append((proc, log))
         return proc
 
+    if args.prefix_cache_mb and (args.batched or args.sp > 1):
+        # Fail HERE with the real reason — forwarding the flag would make
+        # every server exit at startup and the readiness loop would only
+        # report "a swarm process exited early".
+        raise SystemExit(
+            "--prefix_cache_mb is a per-session-executor feature; the "
+            "batched/sp engines refuse it — drop the flag or serve "
+            "session replicas")
+
     common = ["--model", args.model]
     if args.checkpoint:
         common += ["--checkpoint", args.checkpoint]
@@ -135,6 +147,8 @@ def main():
                     role += ["--tp", str(args.tp)]
                 if args.sp > 1:
                     role += ["--sp", str(args.sp)]
+            if args.prefix_cache_mb:
+                role += ["--prefix_cache_mb", str(args.prefix_cache_mb)]
             spawn(common + role, f"stage{i}")
 
         # Readiness = every server's record is live AND ONLINE in the
