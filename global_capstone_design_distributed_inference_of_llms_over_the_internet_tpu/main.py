@@ -800,11 +800,12 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
     if args.sp > 1 and (args.batched or args.tp > 1 or args.use_cpu_offload):
         raise SystemExit("--sp does not compose with --batched/--tp/"
                          "--use_cpu_offload on one server")
-    if args.prefix_cache_mb and (args.batched or args.sp > 1):
+    if args.prefix_cache_mb and args.sp > 1:
         raise SystemExit(
-            "--prefix_cache_mb is a per-session-executor feature; the "
-            "batched/sp engines manage KV slot- or mesh-wise and do not "
-            "consult the store — serve session replicas with it instead")
+            "--prefix_cache_mb does not compose with --sp (the sp engine "
+            "shards one session's prefix KV across the mesh; a shared "
+            "store would need per-device segment sharding) — serve "
+            "session or batched replicas with it instead")
     if args.sp > 1:
         # Sequence-parallel long-context engine: ONE session at a time, its
         # prefix KV sharded along T over the local ('sp',) mesh.
@@ -845,7 +846,8 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
         kv_dtype = (jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32)
         engine = BatchedStageExecutor(
             cfg, spec, _stage_params(args, cfg, params, spec),
-            slots=args.slots, max_len=args.max_session_len, dtype=kv_dtype)
+            slots=args.slots, max_len=args.max_session_len, dtype=kv_dtype,
+            prefix_cache_bytes=args.prefix_cache_mb << 20)
         ex = BatchingStageAdapter(engine, peer_id=peer_id)
     else:
         ex = _SE(cfg, spec, _stage_params(args, cfg, params, spec),

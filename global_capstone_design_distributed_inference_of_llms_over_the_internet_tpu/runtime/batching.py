@@ -74,6 +74,7 @@ class BatchedStageExecutor:
         slots: int = 8,
         max_len: int = 2048,
         dtype=jnp.float32,
+        prefix_cache_bytes: int = 0,
     ):
         from ..models.config import custom_engine_unsupported
 
@@ -100,6 +101,18 @@ class BatchedStageExecutor:
         self.decode_steps = 0                          # batched steps executed
         self._prefill_jit = None
         self._decode_jits: Dict[int, Any] = {}         # step width T -> jit
+        # Prompt-prefix KV reuse (runtime.prefix_cache), slot-layout
+        # variant: entries hold [L, G, Hkv, Dh] KV segments (+ [1, G, D]
+        # output rows off the final stage). Same grain-chained rolling
+        # digests as the session executor's store.
+        self.prefix_store = None
+        if prefix_cache_bytes > 0:
+            from .prefix_cache import PrefixStore
+
+            self.prefix_store = PrefixStore(prefix_cache_bytes)
+        self._suffix_jit = None
+        self._chain_write_jit = None
+        self._grain_split_jits: Dict[tuple, Any] = {}
 
     # ------------------------------------------------------------------
     # Slots
@@ -204,9 +217,215 @@ class BatchedStageExecutor:
 
         return fn
 
-    def prefill(self, session_id: str, x) -> jnp.ndarray:
+    def _build_prefill_suffix(self):
+        """Prefill CONTINUATION for a prefix-cache hit: the suffix enters at
+        position p_len and attends over the slot's cache rows (the copied
+        prefix) plus its own fresh keys — the slot-batched analogue of the
+        session executor's chunked continuation."""
+        cfg, spec = self.cfg, self.spec
+
+        @partial(jax.jit, donate_argnums=engine_donation(3, 4))
+        def fn(params, x, slot, k_all, v_all, p_len, t_real):
+            b = 1
+            t = x.shape[1]
+            positions = p_len + jnp.arange(t, dtype=jnp.int32)[None, :]
+            h = (embed_tokens(cfg, params["embed"], x, positions)
+                 if spec.is_first else x)
+            rope = make_rope(cfg, positions)
+            groups = cfg.num_heads // cfg.num_kv_heads
+            m = k_all.shape[2]
+            pos_grid = jnp.arange(m, dtype=jnp.int32)
+            qpos = positions[0][:, None]                     # [T, 1]
+            allowed = pos_grid[None, :] <= qpos              # [T, M] causal
+            if cfg.sliding_window:
+                allowed &= pos_grid[None, :] > qpos - cfg.sliding_window
+            k_slot = jax.lax.dynamic_index_in_dim(k_all, slot, 1,
+                                                  keepdims=False)
+            v_slot = jax.lax.dynamic_index_in_dim(v_all, slot, 1,
+                                                  keepdims=False)
+
+            def layer(h, xs):
+                from ..models.quant import dequant_tree
+
+                lp, k_l, v_l = xs                    # k_l: [M, Hkv, Dh]
+                lp = dequant_tree(lp)
+                a = _norm(cfg, lp["ln1"], h)
+                q, k, v = qkv_proj(cfg, lp["attn"], a)
+                if rope is not None:
+                    q = apply_rope(q, *rope)
+                    k = apply_rope(k, *rope)
+                k_l = jax.lax.dynamic_update_slice_in_dim(
+                    k_l, k[0].astype(k_l.dtype), p_len, 0)
+                v_l = jax.lax.dynamic_update_slice_in_dim(
+                    v_l, v[0].astype(v_l.dtype), p_len, 0)
+                qg = q.reshape(b, t, cfg.num_kv_heads, groups, cfg.head_dim)
+                scores = jnp.einsum(
+                    "bthgd,shd->bhgts", qg * cfg.head_dim ** -0.5,
+                    k_l.astype(q.dtype),
+                    preferred_element_type=jnp.float32)
+                scores = jnp.where(allowed[None, None, None], scores, NEG_INF)
+                probs = jax.nn.softmax(scores, axis=-1)
+                out = jnp.einsum("bhgts,shd->bthgd",
+                                 probs.astype(v_l.dtype),
+                                 v_l.astype(q.dtype))
+                out = _dot(out.reshape(b, t, -1), lp["attn"]["wo"])
+                if "bo" in lp["attn"]:
+                    out = out + lp["attn"]["bo"]
+                h = h + out
+                h = h + _mlp(cfg, lp["mlp"], _norm(cfg, lp["ln2"], h), None)
+                return h, (k_l, v_l)
+
+            h, (ks, vs) = jax.lax.scan(
+                layer, h, (params["layers"], k_slot, v_slot))
+            k_all = jax.lax.dynamic_update_slice(
+                k_all, ks[:, None], (0, slot, 0, 0, 0))
+            v_all = jax.lax.dynamic_update_slice(
+                v_all, vs[:, None], (0, slot, 0, 0, 0))
+            del t_real  # mask correctness needs only qpos; kept for parity
+            return h, k_all, v_all
+
+        return fn
+
+    def _write_prefix_chain(self, slot: int, chain) -> None:
+        """Write a chain's KV segments into the slot's leading cache rows
+        in ONE jitted dispatch (specialized per chain length)."""
+        if self._chain_write_jit is None:
+            @partial(jax.jit, donate_argnums=engine_donation(0, 1))
+            def fn(k_all, v_all, slot, segs_k, segs_v):
+                kc = (segs_k[0] if len(segs_k) == 1
+                      else jnp.concatenate(segs_k, axis=1))
+                vc = (segs_v[0] if len(segs_v) == 1
+                      else jnp.concatenate(segs_v, axis=1))
+                start = (0, slot, 0, 0, 0)
+                return (jax.lax.dynamic_update_slice(
+                            k_all, kc[:, None].astype(k_all.dtype), start),
+                        jax.lax.dynamic_update_slice(
+                            v_all, vc[:, None].astype(v_all.dtype), start))
+
+            self._chain_write_jit = fn
+        self.k, self.v = self._chain_write_jit(
+            self.k, self.v, jnp.int32(slot),
+            [e.k for e in chain], [e.v for e in chain])
+
+    def _split_grains(self, slot: int, n_grains: int, grain: int):
+        """All grain KV segments of a slot's leading rows as one jitted
+        call (n outputs, ONE dispatch — eager per-grain slicing would pay
+        a device round trip per grain on registration)."""
+        key = (n_grains, grain)
+        fn = self._grain_split_jits.get(key)
+        if fn is None:
+            @jax.jit
+            def fn(k_all, v_all, slot):
+                k_s = jax.lax.dynamic_index_in_dim(k_all, slot, 1,
+                                                   keepdims=False)
+                v_s = jax.lax.dynamic_index_in_dim(v_all, slot, 1,
+                                                   keepdims=False)
+                return ([k_s[:, g * grain:(g + 1) * grain]
+                         for g in range(n_grains)],
+                        [v_s[:, g * grain:(g + 1) * grain]
+                         for g in range(n_grains)])
+
+            self._grain_split_jits[key] = fn
+        return fn(self.k, self.v, jnp.int32(slot))
+
+    def prefill(self, session_id: str, x, prefix_len: int = 0) -> jnp.ndarray:
         """Join/restart a session: x = ids [1, T] (first stage) or hidden
-        [1, T, D]. Returns hidden [1, T, D] (pad rows trimmed)."""
+        [1, T, D]. Returns hidden rows (pad trimmed): all T rows normally;
+        on a prefix-cache hit, the stored prefix rows prepended to the
+        computed suffix (final stage: suffix only — it samples from the
+        last row and stores no outputs)."""
+        if self.prefix_store is not None and prefix_len > 0:
+            return self._prefill_with_store(session_id, x, prefix_len)
+        return self._prefill_full(session_id, x)
+
+    def _prefill_with_store(self, session_id: str, x,
+                            prefix_len: int) -> jnp.ndarray:
+        from .prefix_cache import chain_digests
+
+        x_np = np.asarray(x)
+        t = x_np.shape[1]
+        grain = self.prefix_store.grain
+        n_grains = min(prefix_len, t - 1) // grain
+        if n_grains <= 0:
+            return self._prefill_full(session_id, x)
+        coords = ("slot", self.spec.start, self.spec.end,
+                  str(x_np.dtype), str(self.dtype))
+        blocks = [np.ascontiguousarray(x_np[:, g * grain:(g + 1) * grain])
+                  .tobytes() for g in range(n_grains)]
+        keys = chain_digests(blocks, coords)
+        chain = self.prefix_store.lookup_chain(
+            keys, need_out=not self.spec.is_last)
+        if not chain:
+            h = self._prefill_full(session_id, x)
+            s = self._slot_of[session_id]
+            segs_k, segs_v = self._split_grains(s, n_grains, grain)
+            for g in range(n_grains):
+                out = (None if self.spec.is_last
+                       else h[:, g * grain:(g + 1) * grain])
+                self.prefix_store.put(keys[g], segs_k[g], segs_v[g], out)
+            return h
+        # Hit (possibly partial): copy the chain's KV, compute the rest.
+        p = len(chain) * grain
+        if t > self.max_len:
+            raise ValueError(f"prompt {t} exceeds slot max_len {self.max_len}")
+        s = self._alloc(session_id)
+        suffix = x_np[:, p:]
+        ts = suffix.shape[1]
+        tb = (ts if ts > PREFILL_BUCKETS[-1]
+              else min(round_to_bucket(ts, PREFILL_BUCKETS),
+                       self.max_len - p))
+        if tb != ts:
+            pad = ((0, 0), (0, tb - ts)) + (((0, 0),) if x_np.ndim == 3
+                                            else ())
+            suffix = np.pad(suffix, pad)
+        if self._suffix_jit is None:
+            self._suffix_jit = self._build_prefill_suffix()
+        try:
+            self._write_prefix_chain(s, chain)
+            h, self.k, self.v = self._suffix_jit(
+                self.params, jnp.asarray(suffix), jnp.int32(s), self.k,
+                self.v, jnp.int32(p), jnp.int32(ts))
+        except Exception:
+            self._recover_slot(session_id, s)
+            raise
+        self.lengths[s] = t
+        h = h[:, :ts]
+        full = (h if self.spec.is_last
+                else jnp.concatenate([*(e.out for e in chain), h], axis=1))
+        if len(chain) < n_grains:
+            # Register the grains the chain didn't cover (and REPAIR chains
+            # truncated by LRU eviction of a middle link — the session
+            # executor's pfx_register does the same).
+            segs_k, segs_v = self._split_grains(s, n_grains, grain)
+            for g in range(len(chain), n_grains):
+                out = (None if self.spec.is_last
+                       else full[:, g * grain:(g + 1) * grain])
+                self.prefix_store.put(keys[g], segs_k[g], segs_v[g], out)
+        return full
+
+    def _recover_slot(self, session_id: str, s: int) -> None:
+        """Shared failure recovery for every prefill path: a failed
+        dispatch (e.g. device OOM) must not leak the slot — the session
+        was never established, so recycle it with a clean length. The
+        jitted calls DONATE self.k/self.v, so a failure DURING execution
+        (vs before dispatch) leaves them deleted, which would crash every
+        later step with 'Array has been deleted'; rebuild empty caches and
+        evict all sessions — their KV is gone either way, and a refused
+        decode is retryable (clients fail over and replay) where a
+        poisoned engine is not."""
+        self._slot_of.pop(session_id, None)
+        self.lengths[s] = 0
+        self._free.append(s)
+        if getattr(self.k, "is_deleted", lambda: False)():
+            shape = (max(self.spec.num_layers, 1), self.slots, self.max_len,
+                     self.cfg.num_kv_heads, self.cfg.head_dim)
+            self.k = jnp.zeros(shape, self.dtype)
+            self.v = jnp.zeros(shape, self.dtype)
+            self._slot_of.clear()
+            self.lengths[:] = 0
+            self._free = list(range(self.slots))
+
+    def _prefill_full(self, session_id: str, x) -> jnp.ndarray:
         x = jnp.asarray(x)
         t = x.shape[1]
         if t > self.max_len:
@@ -226,25 +445,7 @@ class BatchedStageExecutor:
             h, self.k, self.v = self._prefill_jit(
                 self.params, x, jnp.int32(s), self.k, self.v, jnp.int32(t))
         except Exception:
-            # Failed dispatch (e.g. device OOM) must not leak the slot: the
-            # session was never established, so recycle it with a clean
-            # length instead of leaving a stale assignment until end_session.
-            self._slot_of.pop(session_id, None)
-            self.lengths[s] = 0
-            self._free.append(s)
-            # The jitted call DONATES self.k/self.v — a failure during
-            # execution (vs before dispatch) leaves them deleted, which
-            # would crash every later step with 'Array has been deleted'.
-            # Rebuild empty caches and evict all sessions: their KV is gone
-            # either way, and a refused decode is retryable (clients fail
-            # over and replay) where a poisoned engine is not.
-            if getattr(self.k, "is_deleted", lambda: False)():
-                shape = self.k.shape
-                self.k = jnp.zeros(shape, self.dtype)
-                self.v = jnp.zeros(shape, self.dtype)
-                self._slot_of.clear()
-                self.lengths[:] = 0
-                self._free = list(range(self.slots))
+            self._recover_slot(session_id, s)
             raise
         self.lengths[s] = t
         return h[:, :t]
@@ -565,7 +766,8 @@ class BatchingStageAdapter:
 
         with self._lock:  # slot tables + cache arrays are shared state
             try:
-                h = self.inner.prefill(req.session_id, req.hidden)
+                h = self.inner.prefill(req.session_id, req.hidden,
+                                       prefix_len=req.prefix_len)
             except StageExecutionError:
                 raise
             except Exception as exc:

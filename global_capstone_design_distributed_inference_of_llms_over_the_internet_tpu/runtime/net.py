@@ -703,7 +703,9 @@ class TcpStageServer(_FramedTcpServer):
             steps = getattr(getattr(ex, "inner", None), "decode_steps", None)
             if steps is not None:
                 frame["decode_steps"] = steps
-            store = getattr(ex, "prefix_store", None)
+            store = (getattr(ex, "prefix_store", None)
+                     or getattr(getattr(ex, "inner", None),
+                                "prefix_store", None))
             if store is not None:
                 frame["prefix_cache"] = store.stats()
             # Structured recent-request tail (_log_request parity): the

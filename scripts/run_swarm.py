@@ -104,14 +104,13 @@ def main():
         procs.append((proc, log))
         return proc
 
-    if args.prefix_cache_mb and (args.batched or args.sp > 1):
+    if args.prefix_cache_mb and args.sp > 1:
         # Fail HERE with the real reason — forwarding the flag would make
         # every server exit at startup and the readiness loop would only
         # report "a swarm process exited early".
         raise SystemExit(
-            "--prefix_cache_mb is a per-session-executor feature; the "
-            "batched/sp engines refuse it — drop the flag or serve "
-            "session replicas")
+            "--prefix_cache_mb does not compose with --sp — drop the flag "
+            "or serve session/batched replicas")
 
     common = ["--model", args.model]
     if args.checkpoint:

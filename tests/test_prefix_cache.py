@@ -278,3 +278,138 @@ def test_end_to_end_client_reuse_token_parity():
     r3_oracle = fresh_client.generate(prompt3, max_new_tokens=6,
                                       sampling=sampling)
     assert r3.tokens == r3_oracle.tokens
+
+
+# ---------------------------------------------------------------------------
+# Batched (slot) engine
+# ---------------------------------------------------------------------------
+
+def _batched_engine(cfg, params, role_last=False, cache_mb=64):
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.partition import (
+        StagePlan,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.batching import (
+        BatchedStageExecutor,
+    )
+
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("2,6"))
+    spec = plan.stages[-1] if role_last else plan.stages[1]
+    ex = BatchedStageExecutor(
+        cfg, spec, slice_stage_params(cfg, params, spec),
+        slots=4, max_len=64, prefix_cache_bytes=cache_mb << 20)
+    ex.prefix_store.grain = GRAIN
+    return ex
+
+
+def test_batched_engine_hit_parity_through_decode():
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ex = _batched_engine(cfg, params)
+    rng = np.random.default_rng(8)
+    hid = rng.standard_normal((1, 40, cfg.hidden_size)).astype(np.float32)
+
+    cold = ex.prefill("cold", hid, prefix_len=40)
+    st = ex.prefix_store.stats()
+    assert st["entries"] == 4 and st["misses"] == 1
+
+    warm = ex.prefill("warm", hid, prefix_len=40)
+    st = ex.prefix_store.stats()
+    assert st["hits"] == 1 and st["grains_reused"] == 4
+    assert warm.shape == cold.shape  # intermediate: full rows returned
+    np.testing.assert_allclose(np.asarray(cold), np.asarray(warm),
+                               atol=1e-5, rtol=1e-5)
+    assert int(ex.lengths[ex.slot("warm")]) == 40
+
+    # batched decode continues both sessions identically from their KV
+    step = rng.standard_normal((1, 1, cfg.hidden_size)).astype(np.float32)
+    for _ in range(3):
+        outs = ex.decode_batch({"cold": jnp.asarray(step),
+                                "warm": jnp.asarray(step)})
+        np.testing.assert_allclose(np.asarray(outs["cold"]),
+                                   np.asarray(outs["warm"]),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_batched_engine_shared_prefix_matches_cacheless():
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(9)
+    shared = rng.standard_normal((1, 32, cfg.hidden_size)).astype(np.float32)
+    tail_a = rng.standard_normal((1, 8, cfg.hidden_size)).astype(np.float32)
+    tail_b = rng.standard_normal((1, 8, cfg.hidden_size)).astype(np.float32)
+
+    cached = _batched_engine(cfg, params)
+    cached.prefill("a", np.concatenate([shared, tail_a], 1), prefix_len=40)
+    warm_b = cached.prefill("b", np.concatenate([shared, tail_b], 1),
+                            prefix_len=40)
+    assert cached.prefix_store.stats()["grains_reused"] == 4
+
+    oracle = _batched_engine(cfg, params, cache_mb=64)
+    cold_b = oracle.prefill("b", np.concatenate([shared, tail_b], 1),
+                            prefix_len=0)
+    np.testing.assert_allclose(np.asarray(cold_b), np.asarray(warm_b),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_batched_engine_final_stage_suffix_only():
+    """is_last stores KV-only entries and a hit returns just the computed
+    suffix (the adapter samples from its last row)."""
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ex = _batched_engine(cfg, params, role_last=True)
+    rng = np.random.default_rng(10)
+    hid = rng.standard_normal((1, 33, cfg.hidden_size)).astype(np.float32)
+
+    cold = ex.prefill("cold", hid, prefix_len=33)
+    warm = ex.prefill("warm", hid, prefix_len=33)
+    assert ex.prefix_store.stats()["grains_reused"] == 4
+    assert warm.shape[1] == 33 - 32  # suffix rows only
+    np.testing.assert_allclose(np.asarray(cold[:, -1]),
+                               np.asarray(warm[:, -1]),
+                               atol=1e-5, rtol=1e-5)
+    assert int(ex.lengths[ex.slot("warm")]) == 33
+
+
+def test_batched_adapter_passes_prefix_len():
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.batching import (
+        BatchingStageAdapter,
+    )
+
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ex = _batched_engine(cfg, params)
+    ad = BatchingStageAdapter(ex, peer_id="b")
+    hid = np.random.default_rng(12).standard_normal(
+        (1, 24, cfg.hidden_size)).astype(np.float32)
+    r1 = ad.forward(StageRequest(
+        session_id="s1", hidden=jnp.asarray(hid), seq_len=24, cur_len=0,
+        is_prefill=True, max_length=64, prefix_len=24))
+    r2 = ad.forward(StageRequest(
+        session_id="s2", hidden=jnp.asarray(hid), seq_len=24, cur_len=0,
+        is_prefill=True, max_length=64, prefix_len=24))
+    assert ex.prefix_store.stats()["hits"] == 1
+    np.testing.assert_allclose(np.asarray(r1.hidden), np.asarray(r2.hidden),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_batched_engine_partial_hit_registers_tail():
+    """A prompt sharing only its head with a stored chain reuses the shared
+    grains AND registers its own tail, so a repeat of the new prompt is a
+    full-chain hit."""
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ex = _batched_engine(cfg, params)
+    rng = np.random.default_rng(13)
+    shared = rng.standard_normal((1, 16, cfg.hidden_size)).astype(np.float32)
+    tail_b = rng.standard_normal((1, 25, cfg.hidden_size)).astype(np.float32)
+    hid_a = np.concatenate(
+        [shared, rng.standard_normal((1, 25, cfg.hidden_size))
+         .astype(np.float32)], 1)
+    hid_b = np.concatenate([shared, tail_b], 1)
+
+    ex.prefill("a", hid_a, prefix_len=41)          # registers 5 grains
+    ex.prefill("b1", hid_b, prefix_len=41)         # 2 shared, registers 3
+    st = ex.prefix_store.stats()
+    assert st["grains_reused"] == 2 and st["entries"] == 8
+    ex.prefill("b2", hid_b, prefix_len=41)         # full-chain hit now
+    assert ex.prefix_store.stats()["grains_reused"] == 2 + 5
