@@ -235,6 +235,19 @@ PRESETS = {
         num_kv_heads=8, intermediate_size=14336,
         max_position_embeddings=131072, rope_theta=500000.0,
     ), rope_scaling=(8.0, 1.0, 4.0, 8192)),
+    # Llama-3.2 small models: 3.1's 128k rope remap + tied embeddings.
+    "llama-3.2-1b": lambda: dataclasses.replace(llama_config(
+        vocab_size=128256, hidden_size=2048, num_layers=16, num_heads=32,
+        num_kv_heads=8, intermediate_size=8192,
+        max_position_embeddings=131072, rope_theta=500000.0,
+        tie_word_embeddings=True,
+    ), rope_scaling=(32.0, 1.0, 4.0, 8192)),
+    "llama-3.2-3b": lambda: dataclasses.replace(llama_config(
+        vocab_size=128256, hidden_size=3072, num_layers=28, num_heads=24,
+        num_kv_heads=8, intermediate_size=8192,
+        max_position_embeddings=131072, rope_theta=500000.0,
+        tie_word_embeddings=True,
+    ), rope_scaling=(32.0, 1.0, 4.0, 8192)),
     "mixtral-8x7b": lambda: mixtral_config(
         vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
         num_kv_heads=8, intermediate_size=14336,
@@ -270,6 +283,11 @@ PRESETS = {
         rope_theta=1000000.0,
     ),
 }
+
+# Qwen2.5 shares the qwen2 architecture (HF model_type "qwen2") — alias
+# the existing entries so a hyperparameter fix can never silently diverge.
+PRESETS["qwen2.5-0.5b"] = PRESETS["qwen2-0.5b"]
+PRESETS["qwen2.5-7b"] = PRESETS["qwen2-7b"]
 
 
 def custom_engine_unsupported(cfg: ModelConfig) -> Optional[str]:

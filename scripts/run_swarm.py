@@ -56,6 +56,10 @@ def main():
                         "engine (--mode serve --batched)")
     p.add_argument("--slots", type=int, default=8,
                    help="--batched: concurrent sessions per server")
+    p.add_argument("--quant", choices=["none", "int8", "nf4"],
+                   default="none",
+                   help="server-side weight-only quantization (forwarded "
+                        "to --mode serve)")
     p.add_argument("--prefix_cache_mb", type=int, default=0,
                    help="enable each server's prompt-prefix KV store "
                         "(forwarded to --mode serve)")
@@ -104,6 +108,10 @@ def main():
         procs.append((proc, log))
         return proc
 
+    if args.quant != "none" and args.tp > 1:
+        raise SystemExit(
+            "--quant does not compose with --tp (the TP shard specs have "
+            "no layout for quantized leaves) — drop one of the flags")
     if args.prefix_cache_mb and args.sp > 1:
         # Fail HERE with the real reason — forwarding the flag would make
         # every server exit at startup and the readiness loop would only
@@ -148,6 +156,8 @@ def main():
                     role += ["--sp", str(args.sp)]
             if args.prefix_cache_mb:
                 role += ["--prefix_cache_mb", str(args.prefix_cache_mb)]
+            if args.quant != "none":
+                role += ["--quant", args.quant]
             spawn(common + role, f"stage{i}")
 
         # Readiness = every server's record is live AND ONLINE in the
