@@ -262,7 +262,8 @@ class PipelineClient:
     # ------------------------------------------------------------------
 
     def _compute_route(self, kind: str = "plain",
-                       min_context: Optional[int] = None) -> List[Hop]:
+                       min_context: Optional[int] = None,
+                       affinity: Optional[str] = None) -> List[Hop]:
         if self.use_module_routing:
             return self._compute_module_route(kind, min_context)
         hops: List[Hop] = []
@@ -275,7 +276,7 @@ class PipelineClient:
                                "long": "sp"}.get(kind),
                 avoid_engine=(SESSION_ONLY_ENGINES if kind == "exotic"
                               else ("sp",) if kind == "spec" else None),
-                min_context=min_context)
+                min_context=min_context, affinity=affinity)
             if peer is None:
                 raise NoRouteError(f"no live server for {key}")
             hops.append(Hop(key, peer, spec.start, spec.end, spec.is_last))
@@ -427,10 +428,29 @@ class PipelineClient:
         return hops
 
     def route(self, refresh: bool = False, kind: str = "plain",
-              min_context: Optional[int] = None) -> List[Hop]:
-        key = (kind, min_context)
+              min_context: Optional[int] = None,
+              affinity: Optional[str] = None) -> List[Hop]:
+        """`affinity` (prompt-head digest) makes the replica choice a
+        rendezvous hash so repeat/shared prompts from ANY client land on
+        the peer whose prefix store is warm (registry._pick_newest). The
+        route cache is keyed by it; distinct prompt heads are unbounded,
+        so the cache evicts LEAST-RECENTLY-USED past a small cap (an
+        in-flight session touches its key every step, so eviction can
+        never yank a live generation's route — FIFO could, silently
+        swapping a mid-session hop for a replica holding no KV)."""
+        if self.use_module_routing:
+            # The module-route planner ignores affinity (span-greedy pick
+            # is already deterministic); keying the cache on it would turn
+            # every distinct prompt head into a full recompute.
+            affinity = None
+        key = (kind, min_context, affinity)
         if refresh or key not in self._routes:
-            self._routes[key] = self._compute_route(kind, min_context)
+            while len(self._routes) >= 64:
+                self._routes.pop(next(iter(self._routes)))
+            self._routes[key] = self._compute_route(kind, min_context,
+                                                    affinity)
+        else:
+            self._routes[key] = self._routes.pop(key)  # LRU touch
         return self._routes[key]
 
     # ------------------------------------------------------------------
@@ -587,7 +607,8 @@ class PipelineClient:
               start_from_position: Optional[int] = None,
               kind: str = "plain",
               min_context: Optional[int] = None,
-              prefix_len: int = 0) -> StageResponse:
+              prefix_len: int = 0,
+              affinity: Optional[str] = None) -> StageResponse:
         """Send the activation through every remote hop; return the final
         hop's response: a sampled token, (num_logprobs > 0, beam mode)
         per-row top-N candidates, or (draft_tokens set, speculative mode)
@@ -610,7 +631,8 @@ class PipelineClient:
                 start_from_position=start_from_position,
             )
         cur = hidden
-        for hop in self.route(kind=kind, min_context=min_context):
+        for hop in self.route(kind=kind, min_context=min_context,
+                              affinity=affinity):
             req = StageRequest(
                 session_id=session_id,
                 hidden=cur,
@@ -894,6 +916,16 @@ class PipelineClient:
         max_length = max_length or (
             prompt_len + max_new_tokens
             + (speculative_k if speculative_k > 0 else 0))
+        # Prefix-cache-aware replica affinity: a digest of the prompt HEAD
+        # (one store grain) steers replica choice via rendezvous hashing,
+        # so shared-prefix prompts from any client land on the peer whose
+        # store is warm. Exotic/long sessions route by capability instead.
+        affinity = None
+        if kind in ("plain", "spec"):
+            import hashlib
+
+            affinity = hashlib.sha1(
+                np.asarray(prompt_ids[:64], np.int32).tobytes()).hexdigest()
 
         ids = jnp.asarray(np.asarray(prompt_ids, np.int32)[None, :])
         generated: List[int] = []
@@ -913,6 +945,7 @@ class PipelineClient:
             is_prefill=True, max_length=max_length, sampling=sampling,
             generated=generated, step_seed=self.seed, stage_times=times,
             kind=kind, min_context=max_length, prefix_len=prompt_len,
+            affinity=affinity,
         )
         ttft = time.monotonic() - t0
         self.last_prefill_stage_times = times
@@ -961,7 +994,7 @@ class PipelineClient:
                 stage_times=times,
                 draft_tokens=drafts if drafts else None,
                 start_from_position=spos,
-                kind=kind, min_context=max_length,
+                kind=kind, min_context=max_length, affinity=affinity,
             )
             accepted = list(resp.tokens) if drafts else [resp.token_id]
             if drafts:

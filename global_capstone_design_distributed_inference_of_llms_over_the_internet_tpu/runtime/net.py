@@ -1768,12 +1768,13 @@ class RemoteRegistry:
 
     def discover_stage(self, stage_index: int, exclude=(), model=None,
                        prefer_engine=None, avoid_engine=None,
-                       min_context=None):
+                       min_context=None, affinity=None):
         self._refresh()
         return self._local.discover_stage(stage_index, exclude, model=model,
                                           prefer_engine=prefer_engine,
                                           avoid_engine=avoid_engine,
-                                          min_context=min_context)
+                                          min_context=min_context,
+                                          affinity=affinity)
 
     def discover_block(self, block: int, exclude=(), model=None):
         self._refresh()

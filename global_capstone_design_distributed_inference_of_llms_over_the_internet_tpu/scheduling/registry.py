@@ -175,14 +175,16 @@ class PlacementRegistry:
                        model: Optional[str] = None,
                        prefer_engine: Optional[str] = None,
                        avoid_engine=None,
-                       min_context: Optional[int] = None) -> Optional[str]:
+                       min_context: Optional[int] = None,
+                       affinity: Optional[str] = None) -> Optional[str]:
         """Pick a server for a fixed-split stage: random among the 5 newest
         live candidates, excluding known-failed peers
         (``src/rpc_transport.py:270-353``). `prefer_engine` narrows to that
         engine when any such candidate exists (soft); `avoid_engine` (one
         name or a sequence) drops those candidates unless nothing else
         remains (a session that a batched/sp peer would refuse should not be
-        routed to one)."""
+        routed to one). `affinity` (a prompt-head digest) replaces the
+        random choice with a rendezvous hash — see `_pick_newest`."""
         cands = [
             r for r in self._live(model=model)
             if r.stage_index == stage_index and r.peer_id not in exclude
@@ -204,7 +206,7 @@ class PlacementRegistry:
             preferred = [r for r in cands if r.engine == prefer_engine]
             if preferred:
                 cands = preferred
-        return self._pick_newest(cands)
+        return self._pick_newest(cands, affinity=affinity)
 
     def discover_block(self, block: int, exclude: Sequence[str] = (),
                        model: Optional[str] = None) -> List[ServerRecord]:
@@ -215,9 +217,25 @@ class PlacementRegistry:
             and r.state == ServerState.ONLINE
         ]
 
-    def _pick_newest(self, cands: List[ServerRecord]) -> Optional[str]:
+    def _pick_newest(self, cands: List[ServerRecord],
+                     affinity: Optional[str] = None) -> Optional[str]:
         if not cands:
             return None
+        if affinity is not None and len(cands) > 1:
+            # Prefix-cache-aware replica choice (no reference counterpart):
+            # rendezvous hash over (affinity, peer) — every client holding
+            # the same prompt head lands on the SAME replica with zero
+            # coordination, so its prefix store actually gets hits across
+            # clients; distinct prompt heads spread uniformly. When the
+            # chosen replica dies it simply leaves the candidate set and
+            # only its share of prompts re-hashes elsewhere. Hashes over
+            # ALL live candidates — the freshness-pool restriction below
+            # would make the winner depend on heartbeat ordering, breaking
+            # cross-client stability exactly when replicas are plentiful.
+            import hashlib
+
+            return max(cands, key=lambda r: hashlib.sha1(
+                (affinity + r.peer_id).encode()).digest()).peer_id
         cands.sort(key=lambda r: r.timestamp, reverse=True)
         pool = cands[:DISCOVERY_POOL]
         return self._rng.choice(pool).peer_id

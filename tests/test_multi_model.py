@@ -61,7 +61,10 @@ def test_two_models_one_registry_no_cross_routing():
     cfg_b = tiny_cfg("gpt2")
     params_a = init_params(jax.random.PRNGKey(0), cfg_a)
     params_b = init_params(jax.random.PRNGKey(1), cfg_b)
-    registry = PlacementRegistry(rng=random.Random(0))
+    # Long TTL: this test's subject is model isolation, not liveness — a
+    # cold-compile run of two swarms can exceed the default 45 s, expiring
+    # the unrefreshed records before the final route assertions.
+    registry = PlacementRegistry(rng=random.Random(0), ttl=3600.0)
     transport = LocalTransport()
     plan_a = _register_swarm(cfg_a, params_a, registry, transport, "llama", 0)
     plan_b = _register_swarm(cfg_b, params_b, registry, transport, "gpt2", 1)

@@ -110,20 +110,20 @@ def test_generation_over_tcp_matches_oracle(swarm):
 def test_tcp_failover_mid_generation(swarm):
     cfg, params, client, transport, servers, _ = swarm
     sampling = SamplingParams(temperature=0.0)
-    # kill the pinned stage-2 server after prefill by stopping its socket
-    route = client.route()
-    hop = next(h for h in route if h.key == "stage2")
-    victim = next(s for s in servers if s.executor.peer_id == hop.peer_id)
-    res_prefix = None  # generation below triggers the failure mid-way
+    # Kill the stage-2 server that ACTUALLY serves the session (observed
+    # from the calls — the route is affinity-keyed, so pre-computing
+    # client.route() could watch a replica the generation never uses).
+    stage2 = {s.executor.peer_id: s for s in servers
+              if s.executor.spec.index == 2}
 
     calls = [0]
     orig_call = transport.call
 
     def failing_call(peer_id, req, timeout=None):
-        if peer_id == hop.peer_id and not req.is_prefill and not req.is_replay:
+        if peer_id in stage2 and not req.is_prefill and not req.is_replay:
             calls[0] += 1
             if calls[0] == 2:
-                victim.stop()
+                stage2[peer_id].stop()
         return orig_call(peer_id, req, timeout)
 
     transport.call = failing_call
@@ -344,18 +344,21 @@ def test_stream_session_failover(swarm):
     the replacement peer, and the tokens are preserved."""
     cfg, params, client, transport, servers, _ = swarm
     sampling = SamplingParams(temperature=0.7, repetition_penalty=1.3)
-    route = client.route()
-    hop = next(h for h in route if h.key == "stage2")
-    victim = next(s for s in servers if s.executor.peer_id == hop.peer_id)
+    # Victim = the stage-2 replica the session actually lands on (see
+    # test_tcp_failover_mid_generation).
+    stage2 = {s.executor.peer_id: s for s in servers
+              if s.executor.spec.index == 2}
+    victim_peer = [None]
 
     calls = [0]
     orig_call = transport.call
 
     def failing_call(peer_id, req, timeout=None):
-        if peer_id == hop.peer_id and not req.is_prefill and not req.is_replay:
+        if peer_id in stage2 and not req.is_prefill and not req.is_replay:
             calls[0] += 1
             if calls[0] == 3:
-                victim.stop()
+                victim_peer[0] = peer_id
+                stage2[peer_id].stop()
         return orig_call(peer_id, req, timeout)
 
     transport.call = failing_call
@@ -365,8 +368,8 @@ def test_stream_session_failover(swarm):
     assert client.recoveries >= 1
     # The replacement server saw a fresh stream_open (metadata re-shipped).
     replacement = next(s for s in servers
-                       if s.executor.spec.index == victim.executor.spec.index
-                       and s is not victim)
+                       if s.executor.peer_id in stage2
+                       and s.executor.peer_id != victim_peer[0])
     assert replacement.stream_opens >= 1
 
 

@@ -413,3 +413,69 @@ def test_batched_engine_partial_hit_registers_tail():
     assert st["grains_reused"] == 2 and st["entries"] == 8
     ex.prefill("b2", hid_b, prefix_len=41)         # full-chain hit now
     assert ex.prefix_store.stats()["grains_reused"] == 2 + 5
+
+
+# ---------------------------------------------------------------------------
+# Prefix-affinity routing (rendezvous hash over replicas)
+# ---------------------------------------------------------------------------
+
+def test_affinity_pick_is_deterministic_and_spreads():
+    import random as _random
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.scheduling.registry import (
+        PlacementRegistry,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.client import (
+        make_server_record,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.partition import (
+        StagePlan,
+        parse_splits,
+    )
+
+    cfg = tiny_cfg()
+    plan = StagePlan.from_splits(cfg.num_layers, parse_splits("3,6"))
+    spec = plan.stages[1]
+    picks = set()
+    for seed in range(5):  # rng must NOT influence affinity picks
+        reg = PlacementRegistry(rng=_random.Random(seed))
+        for r in range(3):
+            reg.register(make_server_record(f"peer-r{r}", spec))
+        picks.add(reg.discover_stage(spec.index, affinity="promptheadA"))
+    assert len(picks) == 1
+    reg = PlacementRegistry(rng=_random.Random(0))
+    for r in range(3):
+        reg.register(make_server_record(f"peer-r{r}", spec))
+    spread = {reg.discover_stage(spec.index, affinity=f"head{i}")
+              for i in range(32)}
+    assert len(spread) > 1  # distinct prompt heads spread over replicas
+
+
+def test_cross_client_affinity_warms_the_same_replica():
+    """Two independent clients with the same prompt must pick the SAME
+    replica chain (rendezvous affinity), so client B's prefill hits the
+    store client A warmed."""
+    from test_runtime_pipeline import build_cluster
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.client import (
+        PipelineClient,
+    )
+
+    cfg = tiny_cfg()
+    client_a, transport, registry, params, plan = build_cluster(
+        cfg, replicas=3, seed=0)
+    stores = {}
+    for pid in transport.peers():
+        ex = transport.executor(pid)
+        ex.prefix_store = PrefixStore(64 << 20, grain=GRAIN)
+        stores[pid] = ex.prefix_store
+    client_b = PipelineClient(cfg, plan, client_a.stage0, transport,
+                              registry, settle_seconds=0.0, seed=99)
+    prompt = list(range(11, 51))
+    sampling = SamplingParams(temperature=0.0)
+    ra = client_a.generate(prompt, max_new_tokens=4, sampling=sampling)
+    rb = client_b.generate(prompt, max_new_tokens=4, sampling=sampling)
+    assert ra.tokens == rb.tokens
+    # exactly the replicas client A warmed got client B's hits
+    hit_peers = {p for p, s in stores.items() if s.stats()["hits"] > 0}
+    miss_peers = {p for p, s in stores.items() if s.stats()["misses"] > 0}
+    assert hit_peers == miss_peers and len(hit_peers) == 2  # 2 remote hops

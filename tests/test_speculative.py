@@ -166,16 +166,20 @@ def test_speculative_survives_failover():
     ref = oracle_generate(cfg, params, PROMPT, 12, GREEDY)
 
     res = None
-    # Inject a transient failure on whichever peer serves the first route:
-    # the speculative round must fail over, REPLAY the (amended) journal into
-    # the replica, and keep producing oracle-identical tokens.
-    first_peer = client.route()[0].peer_id
-    done_prefill = {"n": 0}
+    # Inject a transient failure on whichever peer actually serves the
+    # session (captured from the first tapped call — the route is
+    # affinity-keyed, so pre-computing client.route() could watch a
+    # replica the generation never uses): the speculative round must fail
+    # over, REPLAY the (amended) journal into the replica, and keep
+    # producing oracle-identical tokens.
+    done_prefill = {"n": 0, "peer": None}
 
     def tap(peer_id, req):
         done_prefill["n"] += 1
+        if done_prefill["peer"] is None:
+            done_prefill["peer"] = peer_id
         if done_prefill["n"] == 3:  # prefill + 1 spec round done; fail next
-            transport.fail_next(first_peer, 1)
+            transport.fail_next(done_prefill["peer"], 1)
 
     transport.on_call = tap
     res = client.generate(
