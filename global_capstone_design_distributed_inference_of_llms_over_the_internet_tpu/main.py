@@ -236,6 +236,42 @@ def run_local(args, cfg: ModelConfig, params) -> int:
     return _generate_and_report(args, client.generate, cfg)
 
 
+def _maybe_lora(args, cfg, params, start=None, end=None):
+    """Apply ``--lora``: fold saved adapter deltas (a fine-tune's
+    ``export_lora`` .npz) into the attention weights at LOAD time —
+    serving a tuned model needs no runtime adapter support, and the merge
+    runs BEFORE quantization so int8/nf4 weights include the deltas.
+    start/end select the span's slice (stage serving); None = full model
+    (oracle/fused)."""
+    path = getattr(args, "lora", None)
+    if not path or "layers" not in params:
+        return params
+    from .models.lora import load_lora, merge_lora, slice_lora
+
+    cached = _maybe_lora._cache.get(path)
+    if cached is None:
+        # Load once per process: elastic re-spans and multi-stage local
+        # mode call _stage_params repeatedly.
+        cached = _maybe_lora._cache[path] = load_lora(path)
+    tree, scale = cached
+    # Validate the FULL adapter depth BEFORE slicing — a wrong-model
+    # adapter could slice to exactly a span's width and silently merge
+    # deltas from the wrong layers on every non-final stage.
+    for t, ab in tree.items():
+        if ab["a"].shape[0] != cfg.num_layers:
+            raise SystemExit(
+                f"--lora: adapter {t!r} covers {ab['a'].shape[0]} layers, "
+                f"the model has {cfg.num_layers} (adapter trained for a "
+                "different model?)")
+    if start is not None:
+        tree = slice_lora(tree, start, end)
+    return {**params,
+            "layers": merge_lora(cfg, params["layers"], tree, scale)}
+
+
+_maybe_lora._cache = {}
+
+
 def _maybe_quantize(args, params, tp: int = 1):
     """Apply ``--quant`` weight-only quantization (int8 measured +26%
     decode tokens/s on-chip — docs/PERFORMANCE.md): QuantizedTensor/
@@ -268,7 +304,8 @@ def run_fused(args, cfg: ModelConfig, params) -> int:
     num_stages = args.num_stages or max(1, min(len(jax.devices()) // args.tp, 4))
     while cfg.num_layers % num_stages:
         num_stages -= 1
-    params = _maybe_quantize(args, params, tp=args.tp)
+    params = _maybe_quantize(args, _maybe_lora(args, cfg, params),
+                             tp=args.tp)
     if getattr(args, "ring_sessions", 0) > 1:
         return _run_fused_ring(args, cfg, params, num_stages)
     pipe = IciPipeline.build(cfg, params, num_stages=num_stages,
@@ -346,7 +383,7 @@ def run_oracle(args, cfg: ModelConfig, params) -> int:
     sampler into the scan with the SAME per-step key schedule as the old
     per-token loop, so outputs are bit-identical to it. ``--quant`` serves
     int8/nf4 weights, dequantized per layer inside the scan."""
-    params = _maybe_quantize(args, params)
+    params = _maybe_quantize(args, _maybe_lora(args, cfg, params))
 
     def _drive_chunks(prompt_ids, max_new_tokens, eos_token_id, *,
                       prefill_first_token, run_chunk, chunk):
@@ -735,6 +772,7 @@ def _stage_params(args, cfg: ModelConfig, params, spec):
                                        dtype=_DTYPE_MAP[args.dtype])
     else:
         sp = slice_stage_params(cfg, params, spec)
+    sp = _maybe_lora(args, cfg, sp, spec.start, spec.end)
     # Stage-server TP + quant is guarded downstream (the TP engine's shard
     # tables reject quantized leaves loudly), so no tp check here.
     return _maybe_quantize(args, sp)
@@ -1086,6 +1124,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "run all stages in-process and ignore it.")
     p.add_argument("--dtype", choices=["float32", "bfloat16", "float16"],
                    default="float32")
+    p.add_argument("--lora", default=None, metavar="PATH",
+                   help="serve a fine-tune: fold the adapters saved by "
+                        "DistributedFineTuner.export_lora (.npz) into the "
+                        "weights at load (merged before --quant; every "
+                        "mode that loads weights honors it)")
     p.add_argument("--prefix_cache_mb", type=int, default=0,
                    help="enable the content-addressed prompt-prefix KV "
                         "store with this byte budget (MiB) on session "

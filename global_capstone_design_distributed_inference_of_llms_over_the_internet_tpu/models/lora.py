@@ -117,6 +117,48 @@ def merge_lora(cfg: ModelConfig, layers: Params, lora: Optional[Params],
 
 
 # ---------------------------------------------------------------------------
+# Adapter files: one .npz serves a finished fine-tune
+# ---------------------------------------------------------------------------
+
+def save_lora(path: str, lora: Params, scale: float) -> None:
+    """Write adapters + their scale as one flat .npz ("target/leaf" keys).
+    For a PURE-LoRA fine-tune (no deep prompts / embed / head trained),
+    this file plus the base checkpoint is the tuned model — serve it with
+    ``--lora path`` (deltas fold into the weights at load); the tuner's
+    ``export_lora`` enforces that contract."""
+    import numpy as np
+
+    if not path.endswith(".npz"):
+        path += ".npz"
+    flat = {"__scale__": np.float32(scale)}
+    for t, ab in lora.items():
+        flat[f"{t}/a"] = np.asarray(ab["a"])
+        flat[f"{t}/b"] = np.asarray(ab["b"])
+    np.savez(path, **flat)
+
+
+def load_lora(path: str):
+    """Inverse of `save_lora`: (tree, scale)."""
+    import numpy as np
+
+    if not path.endswith(".npz"):
+        path += ".npz"
+    data = np.load(path)
+    scale = float(data["__scale__"])
+    tree: Params = {}
+    for name in data.files:
+        if name == "__scale__":
+            continue
+        t, leaf = name.split("/", 1)
+        tree.setdefault(t, {})[leaf] = jnp.asarray(data[name])
+    for t, ab in tree.items():
+        if set(ab) != {"a", "b"}:
+            raise ValueError(f"adapter file {path}: target {t!r} missing "
+                             "a/b pair")
+    return tree, scale
+
+
+# ---------------------------------------------------------------------------
 # Wire helpers: a deterministic flatten so adapters ride multi-tensor frames
 # ---------------------------------------------------------------------------
 

@@ -293,6 +293,29 @@ class DistributedFineTuner:
         self.opt_state = state["opt"]
         self.steps = int(data["__steps__"])
 
+    def export_lora(self, path: str, allow_partial: bool = False) -> None:
+        """Write the tuned adapters (+ scale) as a standalone .npz the
+        serving CLI folds into the base weights with ``--lora path``.
+
+        The file captures ONLY the adapters: a tuner that also trained
+        deep prompts (pre_seq > 0) or the embed/head would serve a
+        DIFFERENT model from the .npz than the one it fine-tuned, so
+        export refuses unless the adapters are the sole trainables
+        (construct with ``pre_seq=0, lora_rank=r`` for an exportable
+        pure-LoRA tune) or the caller passes ``allow_partial=True``."""
+        if "lora" not in self.trainables:
+            raise ValueError("no LoRA trainables (construct with lora_rank>0)")
+        if not allow_partial and (
+                self.pre_seq > 0 or self.tune_embed or self.tune_head):
+            raise ValueError(
+                "tuner also trains deep prompts/embed/head, which --lora "
+                "serving cannot apply — construct with pre_seq=0 (and no "
+                "tune_embed/tune_head) for a pure-LoRA fine-tune, or pass "
+                "allow_partial=True to export the adapters alone")
+        from ..models.lora import save_lora
+
+        save_lora(path, self.trainables["lora"], self.lora_scale)
+
     def _mark_failed(self, hop, exc) -> None:
         self.client.failed_peers.setdefault(hop.key, set()).add(hop.peer_id)
         logger.warning("finetune hop %s peer %s failed: %s",

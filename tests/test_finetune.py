@@ -351,3 +351,47 @@ def test_ptune_over_tcp():
     finally:
         for srv in servers:
             srv.stop()
+
+
+def test_export_lora_serves_merged(tmp_path):
+    """export_lora -> load_lora -> merge must reproduce the tuned model:
+    the merged-weights forward equals the training-path forward with the
+    same adapters (the serving contract of --lora)."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.lora import (
+        load_lora,
+        merge_lora,
+    )
+
+    import pytest
+
+    cfg = tiny_cfg()
+    client, transport, registry, params, plan = build_cluster(cfg, splits="2,4,6")
+    ids, targets = make_batch(cfg, 1, 10, seed=6)
+    # pre_seq=0: a PURE-LoRA tune, the exportable configuration.
+    ft = make_tuner(cfg, params, client, pre_seq=0, lr=3e-2, lora_rank=2)
+    for _ in range(3):
+        ft.step(ids, targets)
+
+    path = str(tmp_path / "adapters")
+    ft.export_lora(path)
+    tree, scale = load_lora(path)
+    assert scale == ft.lora_scale
+    np.testing.assert_array_equal(
+        np.asarray(tree["wq"]["b"]),
+        np.asarray(ft.trainables["lora"]["wq"]["b"]))
+
+    merged = {**params, "layers": merge_lora(cfg, params["layers"],
+                                             tree, scale)}
+    tuned_loss = float(oracle_lora_loss(
+        cfg, params, ft.trainables["prompts"], tree, scale, ids, targets))
+    # oracle_ptune_loss over the MERGED weights = serving the .npz
+    merged_loss = float(oracle_ptune_loss(
+        cfg, merged, ft.trainables["prompts"], ids, targets))
+    np.testing.assert_allclose(merged_loss, tuned_loss, rtol=1e-5)
+
+    # a tuner that ALSO trains prompts cannot claim the .npz is the model
+    ft_mixed = make_tuner(cfg, params, client, pre_seq=2, lr=0.0,
+                          lora_rank=2)
+    with pytest.raises(ValueError, match="pure-LoRA|prompts"):
+        ft_mixed.export_lora(str(tmp_path / "partial"))
+    ft_mixed.export_lora(str(tmp_path / "partial"), allow_partial=True)
