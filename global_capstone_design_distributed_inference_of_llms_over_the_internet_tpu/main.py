@@ -156,7 +156,12 @@ def load_model(args) -> Tuple[ModelConfig, dict]:
         cfg = config_from_hf(hf.config)
         params = convert_state_dict(cfg, hf.state_dict(), dtype=np.float32)
         if dtype != jnp.float32:
-            params = jax.tree.map(lambda x: x.astype(dtype), params)
+            # Float leaves only: the gemma2 per-layer "window" leaf is
+            # int32 position arithmetic (see convert_state_dict).
+            params = jax.tree.map(
+                lambda x: (x.astype(dtype)
+                           if jnp.issubdtype(x.dtype, jnp.floating) else x),
+                params)
         return cfg, params
     cfg = get_config(args.model)
     logger.info("no --checkpoint: random-initializing %s (%d layers)",

@@ -291,11 +291,12 @@ PRESETS["qwen2.5-7b"] = PRESETS["qwen2-7b"]
 
 
 def custom_engine_unsupported(cfg: ModelConfig) -> Optional[str]:
-    """Reason the engines that RE-IMPLEMENT the layer body (batched slots,
-    sequence-parallel ring, TP shard specs) cannot serve this config, or
-    None. The gemma2 semantics live in models.transformer.layer_forward,
-    which the session/fused/oracle engines share; engines with their own
-    attention math must refuse rather than silently drop them."""
+    """Reason the sequence-parallel ring engine and the TP shard specs
+    cannot serve this config, or None. The gemma2 semantics live in
+    models.transformer.layer_forward (session/fused/oracle engines) and
+    in runtime.batching's gemma2-aware layer pieces (batched engine);
+    the remaining custom-math engines must refuse rather than silently
+    drop them."""
     if (cfg.post_norms or cfg.attn_softcap or cfg.query_scale
             or cfg.altern_window):
         return ("gemma2 semantics (sandwich norms / softcap / per-layer "

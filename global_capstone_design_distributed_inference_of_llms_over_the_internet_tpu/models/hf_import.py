@@ -282,8 +282,14 @@ def convert_state_dict(
 
     params: Params = {}
     if layers:
+        # Cast FLOAT leaves only: the gemma2 per-layer "window" leaf is
+        # int32 position arithmetic — sweeping it to bf16 would mis-mask
+        # keys past position ~256 (bf16 integers lose exactness there).
         params["layers"] = jax.tree.map(
-            lambda x: jnp.asarray(x, dtype), _stack(layers)
+            lambda x: (jnp.asarray(x, dtype)
+                       if np.issubdtype(np.asarray(x).dtype, np.floating)
+                       else jnp.asarray(x)),
+            _stack(layers)
         )
 
     if include_embed:

@@ -62,6 +62,47 @@ class SlotFull(RuntimeError):
     """No free slot (admission control — the caller queues or fails over)."""
 
 
+# -- gemma2-aware layer pieces shared by the three batched bodies ----------
+
+def _qscale(cfg) -> float:
+    """Attention score scale (gemma2 query_pre_attn_scalar override)."""
+    return cfg.query_scale or cfg.head_dim ** -0.5
+
+
+def _layer_mask(lp, mask, q_pos, k_pos):
+    """Intersect the body's mask with this layer's window (the traced
+    "window" leaf of alternating local/global models — gemma2): <= 0
+    means global. q_pos/k_pos are broadcastable position grids matching
+    the mask's trailing dims. The int32 cast is load-bearing: a dtype
+    sweep over the layer tree (checkpoint conversion at bf16) would
+    otherwise compute the window boundary in bfloat16 and mis-mask keys
+    past position ~256."""
+    w = lp.get("window")
+    if w is None:
+        return mask
+    w = jnp.asarray(w, jnp.int32)
+    return mask & ((k_pos > q_pos - w) | (w <= 0))
+
+
+def _softcap_and_mask(cfg, scores, allowed):
+    """Softcap scores (gemma2 attn_logit_softcapping, pre-mask) then mask."""
+    if cfg.attn_softcap:
+        scores = cfg.attn_softcap * jnp.tanh(scores / cfg.attn_softcap)
+    return jnp.where(allowed, scores, NEG_INF)
+
+
+def _residual(cfg, lp, h, attn_out):
+    """Residual + MLP with optional sandwich norms (gemma2 post_norms:
+    ln3 after attention, ln4 after the MLP, before each residual add)."""
+    if cfg.post_norms:
+        attn_out = _norm(cfg, lp["ln3"], attn_out)
+    h = h + attn_out
+    mlp_out = _mlp(cfg, lp["mlp"], _norm(cfg, lp["ln2"], h), None)
+    if cfg.post_norms:
+        mlp_out = _norm(cfg, lp["ln4"], mlp_out)
+    return h + mlp_out
+
+
 class BatchedStageExecutor:
     """One stage span serving up to `slots` sessions with batched decode."""
 
@@ -76,11 +117,6 @@ class BatchedStageExecutor:
         dtype=jnp.float32,
         prefix_cache_bytes: int = 0,
     ):
-        from ..models.config import custom_engine_unsupported
-
-        reason = custom_engine_unsupported(cfg)
-        if reason:
-            raise ValueError(f"batched engine: {reason}")
         self.cfg = cfg
         self.spec = spec
         # Engine-side fused-QKV layout (one projection matmul per layer,
@@ -173,11 +209,11 @@ class BatchedStageExecutor:
             causal = jnp.tril(jnp.ones((t, t), bool))
             valid = jnp.arange(t)[None, :] < t_real       # mask pad columns
             mask = causal & valid
+            rows = jnp.arange(t)[:, None]
+            cols = jnp.arange(t)[None, :]
             if cfg.sliding_window:
                 # Mistral-style local attention: row i sees cols
                 # (i - window, i].
-                rows = jnp.arange(t)[:, None]
-                cols = jnp.arange(t)[None, :]
                 mask &= cols > rows - cfg.sliding_window
 
             def layer(h, lp):
@@ -192,17 +228,17 @@ class BatchedStageExecutor:
                 groups = cfg.num_heads // cfg.num_kv_heads
                 qg = q.reshape(b, t, cfg.num_kv_heads, groups, cfg.head_dim)
                 scores = jnp.einsum(
-                    "bthgd,bshd->bhgts", qg * cfg.head_dim ** -0.5, k,
+                    "bthgd,bshd->bhgts", qg * _qscale(cfg), k,
                     preferred_element_type=jnp.float32)
-                scores = jnp.where(mask[None, None, None], scores, NEG_INF)
+                m = _layer_mask(lp, mask, rows, cols)
+                scores = _softcap_and_mask(cfg, scores, m[None, None, None])
                 probs = jax.nn.softmax(scores, axis=-1)
                 out = jnp.einsum("bhgts,bshd->bthgd",
                                  probs.astype(v.dtype), v)
                 out = _dot(out.reshape(b, t, -1), lp["attn"]["wo"])
                 if "bo" in lp["attn"]:
                     out = out + lp["attn"]["bo"]
-                h = h + out
-                h = h + _mlp(cfg, lp["mlp"], _norm(cfg, lp["ln2"], h), None)
+                h = _residual(cfg, lp, h, out)
                 return h, (k[0], v[0])
 
             h, (ks, vs) = jax.lax.scan(layer, h, params["layers"])
@@ -260,10 +296,11 @@ class BatchedStageExecutor:
                     v_l, v[0].astype(v_l.dtype), p_len, 0)
                 qg = q.reshape(b, t, cfg.num_kv_heads, groups, cfg.head_dim)
                 scores = jnp.einsum(
-                    "bthgd,shd->bhgts", qg * cfg.head_dim ** -0.5,
+                    "bthgd,shd->bhgts", qg * _qscale(cfg),
                     k_l.astype(q.dtype),
                     preferred_element_type=jnp.float32)
-                scores = jnp.where(allowed[None, None, None], scores, NEG_INF)
+                m = _layer_mask(lp, allowed, qpos, pos_grid[None, :])
+                scores = _softcap_and_mask(cfg, scores, m[None, None, None])
                 probs = jax.nn.softmax(scores, axis=-1)
                 out = jnp.einsum("bhgts,shd->bthgd",
                                  probs.astype(v_l.dtype),
@@ -271,8 +308,7 @@ class BatchedStageExecutor:
                 out = _dot(out.reshape(b, t, -1), lp["attn"]["wo"])
                 if "bo" in lp["attn"]:
                     out = out + lp["attn"]["bo"]
-                h = h + out
-                h = h + _mlp(cfg, lp["mlp"], _norm(cfg, lp["ln2"], h), None)
+                h = _residual(cfg, lp, h, out)
                 return h, (k_l, v_l)
 
             h, (ks, vs) = jax.lax.scan(
@@ -513,10 +549,11 @@ class BatchedStageExecutor:
                 # Attention over [0, query position] per new token.
                 qg = q.reshape(S, T, cfg.num_kv_heads, groups, cfg.head_dim)
                 scores = jnp.einsum(
-                    "bthgd,bshd->bhgts", qg * cfg.head_dim ** -0.5,
+                    "bthgd,bshd->bhgts", qg * _qscale(cfg),
                     k_l.astype(q.dtype),
                     preferred_element_type=jnp.float32)      # [S,Hkv,G,T,M]
-                scores = jnp.where(allowed[:, None, None], scores, NEG_INF)
+                m = _layer_mask(lp, allowed, qpos, pos_grid[None, None, :])
+                scores = _softcap_and_mask(cfg, scores, m[:, None, None])
                 probs = jax.nn.softmax(scores, axis=-1)
                 out = jnp.einsum("bhgts,bshd->bthgd",
                                  probs.astype(v_l.dtype),
@@ -524,8 +561,7 @@ class BatchedStageExecutor:
                 out = _dot(out.reshape(S, T, -1), lp["attn"]["wo"])
                 if "bo" in lp["attn"]:
                     out = out + lp["attn"]["bo"]
-                h = h + out
-                h = h + _mlp(cfg, lp["mlp"], _norm(cfg, lp["ln2"], h), None)
+                h = _residual(cfg, lp, h, out)
                 return h, (k_l, v_l)
 
             h, (k_all, v_all) = jax.lax.scan(

@@ -732,26 +732,73 @@ def test_client_speculative_sampled_batched_matches_per_session():
     assert batched == per_session
 
 
-def test_batched_engine_refuses_gemma2_semantics():
-    """Engines that re-implement the layer body must refuse configs whose
-    semantics live only in models.transformer.layer_forward (gemma2
-    sandwich norms / softcaps / per-layer windows) — silent omission would
-    serve a different model."""
+def _tiny_gemma2():
     from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
         gemma2_config,
-        init_params,
     )
 
-    cfg = gemma2_config(vocab_size=128, hidden_size=32, num_layers=2,
-                        num_heads=2, num_kv_heads=1, intermediate_size=64,
-                        head_dim=16, sliding_window=8,
-                        max_position_embeddings=64)
-    params = init_params(jax.random.PRNGKey(0), cfg)
-    with pytest.raises(ValueError, match="gemma2"):
-        BatchedStageExecutor(cfg, full_spec(cfg), params, slots=2, max_len=32)
+    # sliding_window=4 with 7-token prompts + 6 generated tokens makes the
+    # even (windowed) layers actually truncate attention; head_dim=32 !=
+    # hidden/heads exercises the decoupled projections. Softcaps are set
+    # SMALL on purpose: at the production default (50) a tiny random
+    # model's scores sit deep in tanh's linear region and dropping the cap
+    # would not change a single argmax — the caps must bite for the parity
+    # test to actually cover them.
+    return gemma2_config(vocab_size=257, hidden_size=64, num_layers=4,
+                         num_heads=4, num_kv_heads=2, intermediate_size=128,
+                         head_dim=32, sliding_window=4,
+                         query_pre_attn_scalar=16.0,
+                         attn_softcap=2.0, final_softcap=3.0,
+                         max_position_embeddings=256)
+
+
+@pytest.mark.parity
+def test_batched_gemma2_matches_oracle():
+    """gemma2 semantics (sandwich norms, softcaps, alternating per-layer
+    windows, query scale) on the batched bodies: tokens must match the
+    shared-layer-math oracle per session."""
+    cfg = _tiny_gemma2()
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    ex = BatchedStageExecutor(cfg, full_spec(cfg), params,
+                              slots=4, max_len=64)
+    n_new = 6
+    got = batched_generate(ex, PROMPTS, n_new)
+    for sid, prompt in PROMPTS.items():
+        assert got[sid] == oracle_tokens(cfg, params, prompt, n_new), sid
+
+
+def test_remaining_custom_engines_refuse_gemma2():
+    """The sp ring engine and TP shard specs still re-implement the layer
+    math without gemma2 semantics — they must refuse, not silently serve a
+    different model."""
     from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.parallel.tensor_parallel import (
         validate_tp,
     )
 
     with pytest.raises(ValueError, match="gemma2"):
-        validate_tp(cfg, 2)
+        validate_tp(_tiny_gemma2(), 2)
+
+
+def test_batched_gemma2_with_prefix_cache():
+    """gemma2 semantics and prefix-cache hits compose on the batched
+    engine: a warm suffix-continuation (per-layer windows, softcaps,
+    sandwich norms) must reproduce the cold full-prefill decode tokens."""
+    cfg = _tiny_gemma2()
+    params = init_params(jax.random.PRNGKey(4), cfg)
+    ex = BatchedStageExecutor(cfg, full_spec(cfg), params, slots=4,
+                              max_len=64, prefix_cache_bytes=32 << 20)
+    ex.prefix_store.grain = 8
+    prompt = np.asarray(list(range(20, 53)), np.int32)[None, :]  # 33 tokens
+
+    def gen(sid):
+        h = ex.prefill(sid, prompt, prefix_len=33)
+        toks = [int(jnp.argmax(ex.logits(h)[0, -1]))]
+        for _ in range(4):
+            out = ex.decode_batch({sid: jnp.asarray([[toks[-1]]], jnp.int32)})
+            toks.append(int(jnp.argmax(ex.logits(out[sid])[0, -1])))
+        return toks
+
+    cold = gen("cold")
+    warm = gen("warm")
+    assert ex.prefix_store.stats()["grains_reused"] == 4
+    assert cold == warm
