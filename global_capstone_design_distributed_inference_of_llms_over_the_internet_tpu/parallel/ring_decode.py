@@ -71,7 +71,8 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..models.config import ModelConfig
-from ..models.transformer import _norm, lm_head, stack_forward
+from ..models.transformer import (_norm, embed_tokens, lm_head,
+                                  stack_forward)
 from ..ops.sampling import RECENT_WINDOW, push_recent, sample_token
 from .pipeline import IciPipeline, _kv_spec
 
@@ -140,12 +141,10 @@ def _ring_body(cfg: ModelConfig, num_stages: int, num_groups: int,
             hp = {**head_p, "embed": embed_p}
 
         def embed_tok(tok, pos):
-            # tok [B] -> [B, 1, D]; mirrors fused_decode._decode_step.
-            x = jnp.take(wte, tok[:, None], axis=0)
-            if cfg.positional == "learned":
-                p = jnp.clip(pos, 0, cfg.max_position_embeddings - 1)
-                x = x + jnp.take(embed_p["wpe"], p, axis=0)
-            return x
+            # tok [B] -> [B, 1, D] via the SHARED embed (a hand-rolled wte
+            # gather here once dropped gemma's sqrt(hidden) embed scale —
+            # same bug class as fused_decode._decode_step).
+            return embed_tokens(cfg, embed_p, tok[:, None], pos)
 
         if cfg.tie_word_embeddings:
             w_head = wte                                   # [V, D]
@@ -474,7 +473,6 @@ def make_ring_prefill_group(pipe: IciPipeline, exact_head: bool = True,
             jnp.int32)
         return _last_only_psum(tok0, is_last), k_all[None], v_all[None]
 
-    from ..models.transformer import embed_tokens
 
     @partial(jax.jit, donate_argnums=(4, 5))
     def fn(embed_p, head_p, layers_p, ids, k_all, v_all, g):
@@ -519,7 +517,6 @@ def make_ring_spec_round(pipe: IciPipeline, k_draft: int):
     (toks [G, 1, K+1], n_acc [G, 1], k, v, recent, nvalid)``; per session
     the real run is ``toks[g, 0, :n_acc[g, 0] + 1]``.
     """
-    from ..models.transformer import embed_tokens
     from ..ops.sampling import speculative_verify_jit
 
     cfg = pipe.cfg

@@ -43,7 +43,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.config import ModelConfig
-from ..models.transformer import _norm, lm_head, stack_forward
+from ..models.transformer import _norm, embed_tokens, lm_head, stack_forward
 from ..ops.sampling import RECENT_WINDOW, push_recent, sample_token
 
 Params = Dict[str, Any]
@@ -56,10 +56,9 @@ def _decode_step(cfg: ModelConfig, params: Params, tok: jnp.ndarray,
     path). tok: [B] int32 -> (h [B, T=1, D], kc, vc)."""
     batch = tok.shape[0]
     pos = cl + jnp.zeros((batch, 1), jnp.int32)
-    x = jnp.take(params["embed"]["wte"], tok[:, None], axis=0)
-    if cfg.positional == "learned":
-        p = jnp.clip(pos, 0, cfg.max_position_embeddings - 1)
-        x = x + jnp.take(params["embed"]["wpe"], p, axis=0)
+    # The SHARED embed (models.transformer.embed_tokens): a hand-rolled
+    # wte gather here once dropped gemma's sqrt(hidden) embed scale.
+    x = embed_tokens(cfg, params["embed"], tok[:, None], pos)
     return stack_forward(cfg, params["layers"], x, pos, kc, vc, cl)
 
 

@@ -19,7 +19,7 @@ from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.
 from test_runtime_pipeline import tiny_cfg
 
 
-@pytest.mark.parametrize("family", ["llama", "gpt2"])
+@pytest.mark.parametrize("family", ["llama", "gpt2", "gemma2"])
 @pytest.mark.parametrize("batch", [1, 4])
 def test_fused_decode_matches_oracle(family, batch):
     cfg = tiny_cfg(family)

@@ -423,3 +423,25 @@ def test_ring_spec_round_greedy_output_independent_of_drafts():
     assert rounds < n_tokens, (
         "perfect drafts never accepted: rounds should be well under "
         "one-per-token")
+
+
+def test_ring_decode_gemma2_embed_scale_and_semantics():
+    """Regression for the hand-rolled embed that dropped gemma's
+    sqrt(hidden) scale (fixed by routing through the shared embed_tokens):
+    ring decode of a gemma2 config (embed scale, sandwich norms, softcaps,
+    alternating per-layer windows) must match the per-session oracle."""
+    from test_runtime_pipeline import tiny_cfg as shared_tiny_cfg
+
+    cfg = shared_tiny_cfg("gemma2")  # 4 layers, biting softcaps, window=4
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    S = G = 4
+    pipe = IciPipeline.build(cfg, params, S, num_micro=G)
+    rd = RingDecoder.build(pipe, max_steps=16)
+    rng = np.random.default_rng(9)
+    ids = _prompts(rng, G, 1, 5, cfg.vocab_size)
+    k, v = pipe.init_kv(1, max_len=48)
+    toks = np.asarray(
+        ring_generate(pipe, rd, jnp.asarray(ids), k, v, 8))
+    for g in range(G):
+        ref = oracle_greedy(cfg, params, ids[g, 0], 8)
+        assert toks[:, g, 0].tolist() == ref, g

@@ -57,6 +57,20 @@ def tiny_cfg(family="llama"):
         return qwen2_config(vocab_size=257, hidden_size=64, num_layers=8,
                             num_heads=4, num_kv_heads=2, intermediate_size=128,
                             max_position_embeddings=256)
+    if family == "gemma2":
+        from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+            gemma2_config,
+        )
+
+        # Small softcaps so dropping them would change tokens (the
+        # production 50/30 sit in tanh's linear region on tiny models);
+        # window=4 actually truncates at these sequence lengths.
+        return gemma2_config(vocab_size=257, hidden_size=64, num_layers=4,
+                             num_heads=4, num_kv_heads=2,
+                             intermediate_size=128, head_dim=32,
+                             sliding_window=4, query_pre_attn_scalar=16.0,
+                             attn_softcap=2.0, final_softcap=3.0,
+                             max_position_embeddings=256)
     return llama_config(vocab_size=257, hidden_size=64, num_layers=8,
                         num_heads=4, num_kv_heads=2, intermediate_size=128,
                         max_position_embeddings=256)
