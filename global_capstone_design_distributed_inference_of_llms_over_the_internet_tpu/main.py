@@ -1364,10 +1364,14 @@ def run_status(args) -> int:
                 " rtts=" + ",".join(f"{p}:{v * 1e3:.1f}ms"
                                     for p, v in r.next_server_rtts.items()))
         mdl = f" model={r.model}" if r.model else ""
+        # Engine capability tag (session/batched/sp): the first thing an
+        # operator needs to know when a request class is being refused.
+        eng = (f" eng={r.engine}" if getattr(r, "engine", None)
+               and r.engine != "session" else "")
         print(f"  {r.peer_id:24s} [{r.start_block:3d},{r.end_block:3d}) "
               f"{r.state:8s} thr={r.throughput:8.2f} "
               f"cache_left={r.cache_tokens_left}"
-              f"{' FINAL' if r.final_stage else ''}{mdl}{rtts}{extra}")
+              f"{' FINAL' if r.final_stage else ''}{eng}{mdl}{rtts}{extra}")
     # Coverage summary: contiguous runs of equal server-count, the exact
     # shape of the reference's log (src/dht_utils.py:227-240). The
     # CLIENT-LOCAL prefix (stage 0's span, never served remotely — the
