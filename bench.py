@@ -55,6 +55,15 @@ from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.
 from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.fused_decode import (
     make_fused_decode,
 )
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.telemetry import (
+    catalog as telemetry_catalog,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.telemetry.metrics import (
+    MetricsRegistry,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.telemetry.tracing import (
+    Tracer,
+)
 
 # Spec HBM bandwidth by device generation (GB/s). The roofline denominator.
 HBM_SPEC_GBPS = (
@@ -218,10 +227,20 @@ def bench_config(name, cfg, params, *, batch, max_len, s1, s2, prefill=64,
     if sustained_gbps:
         extra["frac_of_sustained"] = round(
             moved / per_step / (sustained_gbps * 1e9), 3)
+    # Per-config percentiles THROUGH the telemetry histogram (catalog
+    # buckets + the same interpolation --mode status and the exposition
+    # surface use), fed the per-rep slope step times — so the artifact's
+    # p50/p95 and a live scrape's p50/p95 come from one code path.
+    hist = telemetry_catalog.get("client_step_seconds",
+                                 MetricsRegistry(enabled=True))
+    for s in slopes:
+        hist.observe(s)
     return {
         **extra,
         "tokens_per_s": round(batch / per_step, 2),
         "step_ms": round(per_step * 1e3, 3),
+        "step_ms_p50": round(hist.quantile(0.5) * 1e3, 3),
+        "step_ms_p95": round(hist.quantile(0.95) * 1e3, 3),
         "step_ms_spread": [round(slopes[0] * 1e3, 3),
                            round(slopes[-1] * 1e3, 3)],
         "step_ms_median": round(slopes[len(slopes) // 2] * 1e3, 3),
@@ -925,6 +944,91 @@ def bench_interleaved_trainer(num_stages=4, micro_sizes=(4, 6),
     }
 
 
+def bench_telemetry_overhead(step_ms_ref: float, iters=20000, reps=5):
+    """ISSUE 1 acceptance row: default-off telemetry must cost <1% of a
+    fused decode step, shown by BEFORE/AFTER timing.
+
+    The fused decode step is one jitted program — the telemetry a decode
+    step actually pays lives in the host-side wrapper code around it: the
+    client's root/hop spans + step/token metrics, the serving boundary's
+    latency/token/request metrics, and the transport byte counters. This
+    times exactly that per-step sequence (10 metric mutations + 3 spans,
+    the 1-hop in-process pipeline's instrumentation) against a private
+    registry/tracer pair in both states, then prices each against the
+    measured fused step. Timed host-side on purpose: on the tunnel rig the
+    ~100 ms dispatch noise would drown a sub-microsecond delta, and the
+    host cost is the same number a co-located deployment pays."""
+    def build(enabled: bool):
+        reg = MetricsRegistry(enabled=enabled)
+        tracer = Tracer(enabled=enabled)
+        # Handles pre-fetched once, exactly like the instrument sites do.
+        m_step = telemetry_catalog.get("client_step_seconds", reg)
+        m_tok = telemetry_catalog.get("client_tokens_generated_total", reg)
+        m_stage = telemetry_catalog.get(
+            "client_stage_time_seconds", reg).labels(hop="s1", phase="decode")
+        m_sstep = telemetry_catalog.get(
+            "server_step_latency_seconds", reg).labels(phase="decode")
+        m_stok = telemetry_catalog.get(
+            "server_tokens_total", reg).labels(phase="decode")
+        m_sreq = telemetry_catalog.get(
+            "server_requests_total", reg).labels(outcome="ok")
+        m_calls = telemetry_catalog.get(
+            "transport_calls_total", reg).labels(verb="step")
+        m_sent = telemetry_catalog.get("transport_bytes_sent_total", reg)
+        m_recv = telemetry_catalog.get("transport_bytes_received_total", reg)
+
+        def one_step():
+            root = tracer.start_span("pipeline_step", kind="client",
+                                     phase="decode")
+            ctx = root.wire_context(0)
+            hop = tracer.start_span("hop:s1", trace_id=root.trace_id,
+                                    parent_id=root.span_id, kind="client")
+            m_calls.inc()
+            m_sent.inc(4096)
+            srv = tracer.span_from_wire(ctx, "server_forward")
+            m_sstep.observe(0.004)
+            m_stok.inc(1)
+            m_sreq.inc()
+            srv.end()
+            m_recv.inc(4096)
+            hop.end()
+            m_stage.observe(0.004)
+            m_step.observe(0.005)
+            m_tok.inc(1)
+            root.end()
+
+        return one_step
+
+    def time_it(fn):
+        fn()  # warm (child creation, bytecode)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fn()
+            best = min(best, time.perf_counter() - t0)
+        return best / iters
+
+    t_off = time_it(build(False))
+    t_on = time_it(build(True))
+    ref_s = step_ms_ref / 1e3
+    return {
+        "mutators_per_step": 10,
+        "spans_per_step": 3,
+        "disabled_us_per_step": round(t_off * 1e6, 3),
+        "enabled_us_per_step": round(t_on * 1e6, 3),
+        "fused_step_ms_ref": round(step_ms_ref, 3),
+        "overhead_pct_disabled": round(t_off / ref_s * 100, 4),
+        "overhead_pct_enabled": round(t_on / ref_s * 100, 4),
+        "pass_lt_1pct_disabled": bool(t_off / ref_s < 0.01),
+        "note": ("host-side microbench of one decode step's full "
+                 "instrumentation sequence, disabled (default) vs enabled "
+                 "(--telemetry), priced against the measured fused step; "
+                 "disabled mutators are one attribute check + return and "
+                 "disabled spans are the shared no-op singleton"),
+    }
+
+
 def _device_reachable(timeout_s: float = 90.0) -> bool:
     """Probe backend init in a SUBPROCESS: a wedged axon tunnel hangs
     jax.devices() indefinitely, which would turn the driver's bench run
@@ -1089,8 +1193,9 @@ def main():
                                    prefill=8, rounds=8, reps=1)
         rp = bench_prefill(cfg, params, batch=2, seq=32, n1=2, n2=8, reps=1)
         rpx = bench_prefix_cache(cfg, params, seq=96, suffix=16, reps=2)
+        rt = bench_telemetry_overhead(r["step_ms"])
         cfgs = {"smoke": r, "smoke_serving": rs, "smoke_prefill": rp,
-                "smoke_prefix_cache": rpx}
+                "smoke_prefix_cache": rpx, "smoke_telemetry_overhead": rt}
         print(json.dumps({"metric": "smoke", "value": r["tokens_per_s"],
                           "unit": "tokens/s", "vs_baseline": 1.0,
                           "configs": cfgs}))
@@ -1249,6 +1354,14 @@ def main():
     results["pipeline_trainer_interleaved"] = _run_pipeline_row_subprocess(
         "--trainer-row")
 
+    # ISSUE 1 acceptance: default-off telemetry <1% of a fused decode step
+    # (before/after host-side timing vs the flagship b16 step).
+    try:
+        results["telemetry_overhead"] = bench_telemetry_overhead(
+            results["flagship_1b_b16"]["step_ms"])
+    except Exception as exc:
+        results["telemetry_overhead"] = {"error": str(exc)[:200]}
+
     primary = results["flagship_1b_b16"]
 
     prev = None
@@ -1346,6 +1459,8 @@ def _compact_summary(results, primary, vs):
             per_config[name] = row["spec_ticks_per_token_full_accept"]
         elif row.get("intercept_ratio") is not None:  # interleaved trainer
             per_config[name] = row["intercept_ratio"]
+        elif "overhead_pct_disabled" in row:  # telemetry overhead row
+            per_config[name] = row["overhead_pct_disabled"]
         else:
             per_config[name] = "see-full-record"
     out = {

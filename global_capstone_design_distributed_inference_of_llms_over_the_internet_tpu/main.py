@@ -180,6 +180,17 @@ def _model_id(args) -> str:
     return args.model_name or args.model
 
 
+def _client_metrics(args):
+    """Under ``--telemetry`` the client folds its series into the
+    process-global registry (one ``--mode metrics`` scrape shows client +
+    server families); otherwise it keeps its default private registry."""
+    if getattr(args, "telemetry", False):
+        from . import telemetry
+
+        return telemetry.get_registry()
+    return None
+
+
 def run_local(args, cfg: ModelConfig, params) -> int:
     """In-process cluster: servers (fixed or LB) + client, one generation."""
     splits = parse_splits(args.splits) if args.splits else None
@@ -237,6 +248,7 @@ def run_local(args, cfg: ModelConfig, params) -> int:
         request_timeout=args.request_timeout,
         seed=args.seed,
         model=_model_id(args),
+        metrics=_client_metrics(args),
     )
     return _generate_and_report(args, client.generate, cfg)
 
@@ -1082,6 +1094,7 @@ def run_client(args, cfg: ModelConfig, params) -> int:
         seed=args.seed,
         model=_model_id(args),
         long_context_threshold=args.long_context_threshold,
+        metrics=_client_metrics(args),
     )
     try:
         return _generate_and_report(args, client.generate, cfg)
@@ -1101,8 +1114,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mode",
                    choices=["local", "fused", "oracle",
                             "registry", "serve", "client", "status",
-                            "dcn-check"],
+                            "metrics", "dcn-check"],
                    default="local")
+    p.add_argument("--telemetry", action="store_true",
+                   help="enable the process-global metrics registry and "
+                        "request tracer (telemetry package). Servers then "
+                        "answer the 'metrics' verb with a Prometheus text "
+                        "exposition; clients fold their series into the "
+                        "same registry. Default off: every instrument site "
+                        "is a cheap boolean check.")
     p.add_argument("--model", default="gpt2",
                    help="architecture preset (gpt2[-xl], llama-3-8b, ...)")
     p.add_argument("--model_name", default=None,
@@ -1322,6 +1342,64 @@ def _print_swarm_health(infos: dict, total_servers: int = 0) -> None:
               f"{len(pressure)} server(s)")
 
 
+def _status_telemetry_line(tele) -> str:
+    """One-line per-server telemetry aggregate for --mode status (empty
+    when the peer runs telemetry off or has served no steps yet)."""
+    if not tele or not tele.get("steps_total"):
+        return ""
+    parts = [f"steps={tele['steps_total']}"]
+    if tele.get("steps_per_s") is not None:
+        parts.append(f"{tele['steps_per_s']:.1f}/s")
+    if tele.get("step_p50_ms") is not None:
+        parts.append(f"p50={tele['step_p50_ms']:.1f}ms")
+    if tele.get("step_p95_ms") is not None:
+        parts.append(f"p95={tele['step_p95_ms']:.1f}ms")
+    if tele.get("cache_hit_rate") is not None:
+        parts.append(f"cache_hit={tele['cache_hit_rate'] * 100:.0f}%")
+    return "\n" + " " * 26 + "telemetry: " + " ".join(parts)
+
+
+def run_metrics(args) -> int:
+    """Prometheus-text scrape of every live server's process registry (the
+    ``metrics`` verb), concatenated with per-peer comment banners — pipe to
+    a file per peer or straight into promtool. Exit 1 when no server could
+    be scraped."""
+    from .runtime.net import RemoteRegistry, TcpTransport
+    from .scheduling.registry import PlacementRegistry as _PR
+
+    registry = RemoteRegistry(args.registry_addr)
+    records = registry.live_servers(model=args.model_name)
+    if not records:
+        print("no live servers")
+        return 1
+    snap = _PR()
+    for r in records:
+        snap.register(r)
+    tx = TcpTransport(snap, wire_dtype=args.wire_dtype)
+    scraped = 0
+    try:
+        for r in sorted(records, key=lambda r: (r.start_block, r.peer_id)):
+            if not r.address:
+                continue
+            try:
+                text = tx.metrics_text(r.peer_id, timeout=3.0)
+            except Exception as exc:
+                print(f"# peer {r.peer_id}: scrape failed "
+                      f"({type(exc).__name__})")
+                continue
+            print(f"# ==== peer {r.peer_id} [{r.start_block},"
+                  f"{r.end_block}) ====")
+            if text.strip():
+                print(text, end="" if text.endswith("\n") else "\n")
+            else:
+                print("# (telemetry disabled on this peer — "
+                      "start it with --telemetry)")
+            scraped += 1
+    finally:
+        tx.close()
+    return 0 if scraped else 1
+
+
 def run_status(args) -> int:
     """Swarm inspector: live records, per-block coverage summary (the
     reference's ``get_remote_module_infos`` coverage log,
@@ -1358,6 +1436,7 @@ def run_status(args) -> int:
                 infos[r.peer_id] = inf
                 extra = (f" served={inf.get('requests_served')}"
                          f" rtt_probe_ok")
+                extra += _status_telemetry_line(inf.get("telemetry"))
             except Exception as exc:
                 extra = f" info_probe_failed({type(exc).__name__})"
         rtts = ("" if not r.next_server_rtts else
@@ -1433,12 +1512,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    if args.telemetry:
+        # Flip the process-global registry + tracer BEFORE any component
+        # fetches metric handles; register_all() inside makes even
+        # zero-valued families visible to the first scrape.
+        from . import telemetry
+
+        telemetry.enable()
     if args.mode == "registry":
         return run_registry(args, None, None)  # no model needed
     if args.mode == "dcn-check":
         return run_dcn_check(args)  # no model needed
     if args.mode == "status":
         return run_status(args)  # no model needed
+    if args.mode == "metrics":
+        return run_metrics(args)  # no model needed
     cfg, params = load_model(args)
     run = {"local": run_local, "fused": run_fused, "oracle": run_oracle,
            "serve": run_serve, "client": run_client}[args.mode]

@@ -51,6 +51,7 @@ from ..models.transformer import (
 )
 from ..ops.rotary import apply_rope
 from ..parallel.ring_attention import NEG_INF
+from ..telemetry import catalog as _tm
 from .kv_cache import round_to_bucket
 
 Params = Dict[str, Any]
@@ -650,7 +651,7 @@ class _Round:
     T=K+1 speculative verify."""
 
     __slots__ = ("reqs", "outs", "err", "bad", "lengths", "spec", "event",
-                 "closed")
+                 "closed", "t_exec")
 
     def __init__(self):
         self.reqs: Dict[str, Any] = {}
@@ -661,6 +662,7 @@ class _Round:
         self.bad: Dict[str, str] = {}             # per-session exclusions
         self.event = threading.Event()
         self.closed = False
+        self.t_exec = 0.0    # monotonic instant the round's step started
 
 
 class _SlotArenaView:
@@ -714,6 +716,12 @@ class BatchingStageAdapter:
         self.requests_served = 0
         self._lock = threading.Lock()
         self._rounds: Dict[int, _Round] = {}   # step width T -> open round
+        # Telemetry (global registry; strict no-op unless enabled). Step
+        # latency itself is observed at the serving boundary (LocalTransport
+        # / TcpStageServer) — the adapter owns the batching-specific signals.
+        self._m_queue_wait = _tm.get("server_queue_wait_seconds")
+        self._m_fill = _tm.get("server_batch_fill_sessions")
+        self._m_round = _tm.get("server_decode_round_seconds")
         # TcpStageServer's info verb + heartbeat read `.arena.tokens_left()`
         # on whatever executor they serve; point that surface at the slot
         # tables so a batched server advertises real admission headroom.
@@ -855,6 +863,7 @@ class BatchingStageAdapter:
 
         sid = req.session_id
         t = req.seq_len
+        t_join = time.monotonic()
         with self._lock:
             reason = self._validate(req)
             if reason is not None:
@@ -890,6 +899,8 @@ class BatchingStageAdapter:
                         else:
                             r.bad[s_id] = reason
                     if good:
+                        r.t_exec = time.monotonic()
+                        self._m_fill.observe(len(good))
                         r.outs = self.inner.decode_batch(
                             {s_id: rq.hidden for s_id, rq in good.items()})
                         if self.spec.is_last:
@@ -898,6 +909,7 @@ class BatchingStageAdapter:
                             s_id: int(self.inner.lengths[self.inner.slot(s_id)])
                             for s_id in good
                         }
+                        self._m_round.observe(time.monotonic() - r.t_exec)
             except Exception as exc:  # whole-round failure
                 r.err = exc
                 with self._lock:  # a dead round must not accept joiners
@@ -908,6 +920,11 @@ class BatchingStageAdapter:
                 r.event.set()
         elif not r.event.wait(self.step_timeout):
             raise StageExecutionError("batched step timed out")
+        if r.t_exec:
+            # Time this session spent parked before its round's step ran —
+            # the coalescing window for the leader, window + leader overhead
+            # for followers.
+            self._m_queue_wait.observe(max(0.0, r.t_exec - t_join))
         if r.err is not None:
             raise StageExecutionError(str(r.err)) from r.err
         if sid in r.bad:

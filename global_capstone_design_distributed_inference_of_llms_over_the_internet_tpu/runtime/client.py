@@ -28,6 +28,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -37,6 +38,8 @@ from ..models.config import ModelConfig
 from ..models.partition import StagePlan, StageSpec
 from ..ops.sampling import SamplingParams
 from ..scheduling.registry import PlacementRegistry, ServerRecord
+from ..telemetry import MetricsRegistry, get_tracer
+from ..telemetry import catalog as _tm
 from .executor import StageExecutionError, StageExecutor
 from .messages import StageRequest, StageResponse, clip_generated
 from .transport import PeerUnavailable, Transport
@@ -186,6 +189,7 @@ class PipelineClient:
         seed: int = 0,
         model: Optional[str] = None,
         long_context_threshold: Optional[int] = None,
+        metrics: Optional[MetricsRegistry] = None,
     ):
         self.cfg = cfg
         # Multi-model swarm: every discovery/coverage query is scoped to this
@@ -251,11 +255,40 @@ class PipelineClient:
         self._ping_cache: Dict[str, Tuple[float, float]] = {}
         self.ping_cache_ttl = 30.0
 
-        # Metrics mirroring RpcTransport.last_prefill_stage_times /
-        # decode_stage_history (src/rpc_transport.py:98-103).
+        # Telemetry: ONE owner of client metric state (replaces the ad-hoc
+        # int/dict mirrors of RpcTransport.last_prefill_stage_times /
+        # decode_stage_history, src/rpc_transport.py:98-103). The client
+        # carries a private ALWAYS-ON registry by default — `recoveries` is
+        # load-bearing API and must count regardless of the process-global
+        # flag; pass the global registry (telemetry.get_registry()) to fold
+        # client series into a process scrape.
+        self.metrics = metrics if metrics is not None else \
+            MetricsRegistry(enabled=True)
+        self._m_ttft = _tm.get("client_ttft_seconds", self.metrics)
+        self._m_step = _tm.get("client_step_seconds", self.metrics)
+        self._m_stage_time = _tm.get("client_stage_time_seconds", self.metrics)
+        self._m_retries = _tm.get("client_retries_total", self.metrics)
+        self._m_recoveries = _tm.get("client_recoveries_total", self.metrics)
+        self._m_generations = _tm.get("client_generations_total", self.metrics)
+        self._m_tokens = _tm.get("client_tokens_generated_total", self.metrics)
+        # Route-plan events go to the PROCESS-GLOBAL registry (scheduler
+        # metric family, shared with the latency planner in
+        # scheduling.routing) — they describe swarm behaviour, not this
+        # client's private counters.
+        self._m_route_plans = _tm.get("scheduler_route_plans_total")
+        self._m_route_hops = _tm.get("scheduler_route_hops")
+        # Last-REQUEST views kept for API compatibility (status displays and
+        # tests read them); cumulative aggregates live in self.metrics.
         self.last_prefill_stage_times: Dict[str, float] = {}
-        self.decode_stage_history: List[Dict[str, float]] = []
-        self.recoveries: int = 0
+        # Bounded: the old unbounded list leaked one dict per decode step for
+        # the life of the client.
+        self.decode_stage_history = deque(maxlen=512)
+
+    @property
+    def recoveries(self) -> int:
+        """Successful failovers to a replacement server — a registry-backed
+        view of ``client_recoveries_total`` (the old ad-hoc int)."""
+        return int(self._m_recoveries.value)
 
     # ------------------------------------------------------------------
     # Routing
@@ -280,6 +313,8 @@ class PipelineClient:
             if peer is None:
                 raise NoRouteError(f"no live server for {key}")
             hops.append(Hop(key, peer, spec.start, spec.end, spec.is_last))
+        self._m_route_plans.labels(planner="stage").inc()
+        self._m_route_hops.observe(len(hops))
         return hops
 
     def _ping_candidates(self, peer_ids: Sequence[str]) -> Dict[str, float]:
@@ -425,6 +460,8 @@ class PipelineClient:
                 )
             hops.append(Hop(key, best.peer_id, covered, best.end_block, is_final))
             covered = best.end_block
+        self._m_route_plans.labels(planner="greedy").inc()
+        self._m_route_hops.observe(len(hops))
         return hops
 
     def route(self, refresh: bool = False, kind: str = "plain",
@@ -531,6 +568,7 @@ class PipelineClient:
             except (PeerUnavailable, TimeoutError, ConnectionError,
                     StageExecutionError) as exc:
                 last_exc = exc
+                self._m_retries.inc()
                 failed = self.failed_peers.setdefault(hop.key, set())
                 failed.add(hop.peer_id)
                 logger.warning(
@@ -542,7 +580,7 @@ class PipelineClient:
                 except NoRouteError:
                     continue  # maybe a peer re-registers before we run out
                 hop.peer_id = replacement
-                self.recoveries += 1
+                self._m_recoveries.inc()
                 try:
                     self._replay(hop, req.session_id, req.sampling, req.max_length)
                 except Exception as replay_exc:  # replacement died too
@@ -608,7 +646,8 @@ class PipelineClient:
               kind: str = "plain",
               min_context: Optional[int] = None,
               prefix_len: int = 0,
-              affinity: Optional[str] = None) -> StageResponse:
+              affinity: Optional[str] = None,
+              trace_ctx=None) -> StageResponse:
         """Send the activation through every remote hop; return the final
         hop's response: a sampled token, (num_logprobs > 0, beam mode)
         per-row top-N candidates, or (draft_tokens set, speculative mode)
@@ -618,6 +657,7 @@ class PipelineClient:
         or its later beam/speculative steps land on a peer that refuses
         them."""
         sampling = sampling or SamplingParams()
+        phase = "prefill" if is_prefill else "decode"
         # Deep-prompt sessions never push-chain: a relay would need the NEXT
         # hop's prompt slice, which only the client holds (petals' handler
         # likewise sets can_push = not has_prompts,
@@ -629,60 +669,91 @@ class PipelineClient:
                 step_seed=step_seed, stage_times=stage_times,
                 draft_tokens=draft_tokens,
                 start_from_position=start_from_position,
+                trace_ctx=trace_ctx,
             )
+        tracer = get_tracer()
+        # One trace per pipeline step; callers that opened a step-level root
+        # (the generate loop) pass it in so stage0 and every hop share the
+        # trace_id, others get their own root here.
+        own_root = trace_ctx is None
+        root = trace_ctx if trace_ctx is not None else tracer.start_span(
+            "pipeline_step", kind="client", session_id=session_id, phase=phase)
         cur = hidden
-        for hop in self.route(kind=kind, min_context=min_context,
-                              affinity=affinity):
-            req = StageRequest(
-                session_id=session_id,
-                hidden=cur,
-                seq_len=seq_len,
-                cur_len=cur_len,
-                is_prefill=is_prefill,
-                max_length=max_length,
-                sampling=sampling,
-                generated_tokens=clip_generated(generated),
-                step_seed=step_seed,
-                start_block=hop.start_block,
-                end_block=hop.end_block,
-                hypo_ids=hypo_ids,
-                num_logprobs=num_logprobs,
-                draft_tokens=draft_tokens,
-                start_from_position=start_from_position,
-                prompts=self._hop_prompts(session_id, hop, cur_len),
-                prefix_len=prefix_len if is_prefill else 0,
-            )
-            t0 = time.monotonic()
-            resp = self._call_with_recovery(hop, req)
-            stage_times[hop.key] = time.monotonic() - t0
-            # Journal AFTER success: replay then rebuilds exactly the applied
-            # history and the failed in-flight step is retried separately.
-            # (The reference appends BEFORE the call and replays the full
-            # journal including the in-flight entry — `rpc_transport.py:741`
-            # vs `:648-654` — re-applying the current step; we fix that.)
-            self._journal_append(
-                hop.key, session_id,
-                JournalEntry(np.asarray(cur), seq_len, cur_len,
-                             hypo_ids=hypo_ids),
-            )
-            if hop.expect_token:
-                if num_logprobs > 0:
-                    if not resp.is_beam:
+        try:
+            for i, hop in enumerate(self.route(kind=kind,
+                                               min_context=min_context,
+                                               affinity=affinity)):
+                wire_ctx = root.wire_context(hop=i) if root else None
+                req = StageRequest(
+                    session_id=session_id,
+                    hidden=cur,
+                    seq_len=seq_len,
+                    cur_len=cur_len,
+                    is_prefill=is_prefill,
+                    max_length=max_length,
+                    sampling=sampling,
+                    generated_tokens=clip_generated(generated),
+                    step_seed=step_seed,
+                    start_block=hop.start_block,
+                    end_block=hop.end_block,
+                    hypo_ids=hypo_ids,
+                    num_logprobs=num_logprobs,
+                    draft_tokens=draft_tokens,
+                    start_from_position=start_from_position,
+                    prompts=self._hop_prompts(session_id, hop, cur_len),
+                    prefix_len=prefix_len if is_prefill else 0,
+                    trace=wire_ctx,
+                )
+                hop_span = tracer.start_span(
+                    f"hop:{hop.key}", trace_id=root.trace_id,
+                    parent_id=root.span_id, kind="client", peer=hop.peer_id,
+                    phase=phase) if root else root
+                t0 = time.monotonic()
+                try:
+                    resp = self._call_with_recovery(hop, req)
+                except BaseException as exc:
+                    hop_span.end(error=repr(exc))
+                    raise
+                dt = time.monotonic() - t0
+                hop_span.end(server=resp.span)
+                stage_times[hop.key] = dt
+                self._m_stage_time.labels(hop=hop.key, phase=phase).observe(dt)
+                # Journal AFTER success: replay then rebuilds exactly the
+                # applied history and the failed in-flight step is retried
+                # separately. (The reference appends BEFORE the call and
+                # replays the full journal including the in-flight entry —
+                # `rpc_transport.py:741` vs `:648-654` — re-applying the
+                # current step; we fix that.)
+                self._journal_append(
+                    hop.key, session_id,
+                    JournalEntry(np.asarray(cur), seq_len, cur_len,
+                                 hypo_ids=hypo_ids),
+                )
+                if hop.expect_token:
+                    if num_logprobs > 0:
+                        if not resp.is_beam:
+                            raise RuntimeError(
+                                f"final hop {hop.key} returned no beam "
+                                "candidates"
+                            )
+                    elif draft_tokens is not None:
+                        if not resp.is_speculative:
+                            raise RuntimeError(
+                                f"final hop {hop.key} returned no verified "
+                                "tokens"
+                            )
+                    elif not resp.is_token:
                         raise RuntimeError(
-                            f"final hop {hop.key} returned no beam candidates"
-                        )
-                elif draft_tokens is not None:
-                    if not resp.is_speculative:
-                        raise RuntimeError(
-                            f"final hop {hop.key} returned no verified tokens"
-                        )
-                elif not resp.is_token:
-                    raise RuntimeError(f"final hop {hop.key} returned no token")
-                return resp
-            if resp.hidden is None:
-                raise RuntimeError(f"hop {hop.key} returned no hidden states")
-            cur = resp.hidden
-        raise RuntimeError("route had no final hop")
+                            f"final hop {hop.key} returned no token")
+                    return resp
+                if resp.hidden is None:
+                    raise RuntimeError(
+                        f"hop {hop.key} returned no hidden states")
+                cur = resp.hidden
+            raise RuntimeError("route had no final hop")
+        finally:
+            if own_root:
+                root.end()
 
     # ------------------------------------------------------------------
     # Push-chain walk (petals handler.py:320-350 server→server push): the
@@ -757,7 +828,33 @@ class PipelineClient:
                     step_seed: int,
                     stage_times: Dict[str, float],
                     draft_tokens: Optional[Tuple[int, ...]] = None,
-                    start_from_position: Optional[int] = None) -> StageResponse:
+                    start_from_position: Optional[int] = None,
+                    trace_ctx=None) -> StageResponse:
+        tracer = get_tracer()
+        own_root = trace_ctx is None
+        root = trace_ctx if trace_ctx is not None else tracer.start_span(
+            "pipeline_step", kind="client", session_id=session_id,
+            phase="prefill" if is_prefill else "decode")
+        try:
+            return self._walk_chain_traced(
+                hidden, seq_len, cur_len, session_id, is_prefill=is_prefill,
+                max_length=max_length, sampling=sampling, generated=generated,
+                step_seed=step_seed, stage_times=stage_times,
+                draft_tokens=draft_tokens,
+                start_from_position=start_from_position, root=root)
+        finally:
+            if own_root:
+                root.end()
+
+    def _walk_chain_traced(self, hidden, seq_len: int, cur_len: int,
+                           session_id: str, *, is_prefill: bool,
+                           max_length: int, sampling: SamplingParams,
+                           generated: Sequence[int], step_seed: int,
+                           stage_times: Dict[str, float],
+                           draft_tokens: Optional[Tuple[int, ...]],
+                           start_from_position: Optional[int],
+                           root) -> StageResponse:
+        tracer = get_tracer()
         touched = self._session_peers.setdefault(session_id, set())
         last_exc: Optional[Exception] = None
         blacklist_cleared = False
@@ -789,6 +886,11 @@ class PipelineClient:
                 step_seed=step_seed, draft_tokens=draft_tokens,
                 start_from_position=start_from_position,
             )
+            req.trace = root.wire_context(hop=0) if root else None
+            chain_span = tracer.start_span(
+                "hop:chain", trace_id=root.trace_id, parent_id=root.span_id,
+                kind="client", peer=hops[0].peer_id,
+                chain_len=len(hops)) if root else root
             t0 = time.monotonic()
             try:
                 resp = self.transport.call(
@@ -798,7 +900,9 @@ class PipelineClient:
                 )
             except (PeerUnavailable, TimeoutError, ConnectionError,
                     StageExecutionError) as exc:
+                chain_span.end(error=repr(exc))
                 last_exc = exc
+                self._m_retries.inc()
                 self._blame_chain_failure(hops, exc)
                 try:
                     new_hops = self.route(kind="exotic")
@@ -815,11 +919,16 @@ class PipelineClient:
                     last_exc = rexc
                     self._blame_chain_failure(new_hops, rexc)
                     continue
-                self.recoveries += 1
+                self._m_recoveries.inc()
                 if self.settle_seconds:
                     time.sleep(self.settle_seconds)
                 continue
-            stage_times[self.CHAIN_KEY] = time.monotonic() - t0
+            dt = time.monotonic() - t0
+            chain_span.end(server=resp.span)
+            stage_times[self.CHAIN_KEY] = dt
+            self._m_stage_time.labels(
+                hop=self.CHAIN_KEY,
+                phase="prefill" if is_prefill else "decode").observe(dt)
             self._journal_append(
                 self.CHAIN_KEY, session_id,
                 JournalEntry(np.asarray(hidden), seq_len, cur_len),
@@ -932,22 +1041,34 @@ class PipelineClient:
         stopped_by = "max_tokens"
 
         # ---- prefill (src/main.py:138-155) ----
+        tracer = get_tracer()
         t0 = time.monotonic()
+        root = tracer.start_span("pipeline_step", kind="client",
+                                 session_id=session_id, phase="prefill")
+        s0_span = tracer.start_span(
+            "hop:stage0", trace_id=root.trace_id, parent_id=root.span_id,
+            kind="client", phase="prefill",
+            peer=getattr(self.stage0, "peer_id", "stage0")) if root else root
         s0_resp = self.stage0.forward(StageRequest(
             session_id=session_id, hidden=ids, seq_len=prompt_len, cur_len=0,
             is_prefill=True, max_length=max_length, sampling=sampling,
             prompts=self._span_prompts(session_id, s0.start, s0.end, 0),
             prefix_len=prompt_len,
         ))
+        s0_span.end()
         times: Dict[str, float] = {}
-        resp = self._walk(
-            s0_resp.hidden, prompt_len, 0, session_id,
-            is_prefill=True, max_length=max_length, sampling=sampling,
-            generated=generated, step_seed=self.seed, stage_times=times,
-            kind=kind, min_context=max_length, prefix_len=prompt_len,
-            affinity=affinity,
-        )
+        try:
+            resp = self._walk(
+                s0_resp.hidden, prompt_len, 0, session_id,
+                is_prefill=True, max_length=max_length, sampling=sampling,
+                generated=generated, step_seed=self.seed, stage_times=times,
+                kind=kind, min_context=max_length, prefix_len=prompt_len,
+                affinity=affinity, trace_ctx=root,
+            )
+        finally:
+            root.end()
         ttft = time.monotonic() - t0
+        self._m_ttft.observe(ttft)
         self.last_prefill_stage_times = times
         generated.append(resp.token_id)
 
@@ -979,29 +1100,39 @@ class PipelineClient:
             spos = cur_len if speculative_k > 0 else None
             step_ids = jnp.asarray([[generated[-1], *drafts]], jnp.int32)
             t_in = 1 + len(drafts)
-            s0_resp = self.stage0.forward(StageRequest(
-                session_id=session_id, hidden=step_ids, seq_len=t_in,
-                cur_len=cur_len, is_prefill=False, max_length=max_length,
-                sampling=sampling, start_from_position=spos,
-                prompts=self._span_prompts(session_id, s0.start, s0.end,
-                                           cur_len),
-            ))
-            times: Dict[str, float] = {}
-            resp = self._walk(
-                s0_resp.hidden, t_in, cur_len, session_id,
-                is_prefill=False, max_length=max_length, sampling=sampling,
-                generated=generated, step_seed=self.seed + len(generated),
-                stage_times=times,
-                draft_tokens=drafts if drafts else None,
-                start_from_position=spos,
-                kind=kind, min_context=max_length, affinity=affinity,
-            )
+            step_span = tracer.start_span(
+                "pipeline_step", kind="client", session_id=session_id,
+                phase="decode", step=len(generated))
+            try:
+                s0_resp = self.stage0.forward(StageRequest(
+                    session_id=session_id, hidden=step_ids, seq_len=t_in,
+                    cur_len=cur_len, is_prefill=False, max_length=max_length,
+                    sampling=sampling, start_from_position=spos,
+                    prompts=self._span_prompts(session_id, s0.start, s0.end,
+                                               cur_len),
+                ))
+                times: Dict[str, float] = {}
+                resp = self._walk(
+                    s0_resp.hidden, t_in, cur_len, session_id,
+                    is_prefill=False, max_length=max_length, sampling=sampling,
+                    generated=generated, step_seed=self.seed + len(generated),
+                    stage_times=times,
+                    draft_tokens=drafts if drafts else None,
+                    start_from_position=spos,
+                    kind=kind, min_context=max_length, affinity=affinity,
+                    trace_ctx=step_span,
+                )
+            finally:
+                step_span.end()
             accepted = list(resp.tokens) if drafts else [resp.token_id]
             if drafts:
                 # Shrink the round's journal entries to the accepted prefix:
                 # replay must rebuild only VALID KV positions.
                 self._amend_speculative_journal(session_id, len(accepted))
-            decode_times.append(time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            decode_times.append(dt)
+            self._m_step.observe(dt)
+            self._m_tokens.inc(len(accepted))
             self.decode_stage_history.append(times)
             cur_len += len(accepted)   # [g_last] + n_acc drafts consumed
             # Stop conditions are checked PER TOKEN inside the accepted run:
@@ -1025,6 +1156,7 @@ class PipelineClient:
                 stopped_by = stop
                 break
 
+        self._m_generations.inc()
         return GenerationResult(
             tokens=generated, ttft_s=ttft, decode_times_s=decode_times,
             stopped_by=stopped_by,
@@ -1091,6 +1223,7 @@ class PipelineClient:
             kind="exotic",
         )
         ttft = time.monotonic() - t0
+        self._m_ttft.observe(ttft)
         self.last_prefill_stage_times = times
 
         def norm(score: float, length: int) -> float:

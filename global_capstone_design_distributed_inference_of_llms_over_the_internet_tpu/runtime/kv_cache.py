@@ -37,6 +37,8 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..telemetry import catalog as _tm
+
 
 class AllocationFailed(RuntimeError):
     """Raised when the arena cannot satisfy an allocation within the timeout
@@ -136,6 +138,18 @@ class KVArena:
         self.sharding = sharding
         self.bytes_divisor = max(int(bytes_divisor), 1)
 
+        # Telemetry (process-global registry; strict no-op unless enabled).
+        # The gauges are process-level: a serve process runs one arena, and
+        # with several arenas in-process (tests, local swarms) the most
+        # recently active one wins — documented in docs/OBSERVABILITY.md.
+        self._m_used = _tm.get("server_kv_used_bytes")
+        self._m_capacity = _tm.get("server_kv_capacity_bytes")
+        self._m_ratio = _tm.get("server_kv_occupancy_ratio")
+        self._m_allocs = _tm.get("server_kv_alloc_total")
+        self._m_alloc_failures = _tm.get("server_kv_alloc_failures_total")
+        self._m_alloc_wait = _tm.get("server_kv_alloc_wait_seconds")
+        self._m_evictions = _tm.get("server_kv_evictions_total")
+
         self._lock = threading.Condition()
         self._used_bytes = 0
         # Bytes already promised to waiting allocations, so concurrent waiters
@@ -170,6 +184,13 @@ class KVArena:
                      * self.dtype.itemsize) // self.bytes_divisor
         return max(0, self.bytes_left) // max(per_token, 1)
 
+    def _publish_occupancy(self) -> None:
+        used = self._used_bytes
+        self._m_used.set(used)
+        self._m_capacity.set(self.max_bytes)
+        if self.max_bytes > 0:
+            self._m_ratio.set(used / self.max_bytes)
+
     # -- allocation ---------------------------------------------------------
 
     def allocate(
@@ -185,16 +206,22 @@ class KVArena:
         ``backend.py:88-99``)."""
         timeout = self.alloc_timeout if timeout is None else timeout
         layers = self.num_layers if num_layers is None else num_layers
-        bucket_len = round_to_bucket(max_length, self.buckets)
-        nbytes = self.bytes_for(bucket_len, layers, batch)
-        if nbytes > self.max_bytes:
-            raise AllocationFailed(
-                f"allocation of {nbytes} bytes can never fit arena of "
-                f"{self.max_bytes} bytes"
-            )
+        t_alloc = time.monotonic()
+        try:
+            bucket_len = round_to_bucket(max_length, self.buckets)
+            nbytes = self.bytes_for(bucket_len, layers, batch)
+            if nbytes > self.max_bytes:
+                raise AllocationFailed(
+                    f"allocation of {nbytes} bytes can never fit arena of "
+                    f"{self.max_bytes} bytes"
+                )
+        except AllocationFailed:
+            self._m_alloc_failures.inc()
+            raise
         deadline = time.monotonic() + timeout
         with self._lock:
             if session_id in self._handles or session_id in self._pending:
+                self._m_alloc_failures.inc()
                 raise AllocationFailed(f"session {session_id} already allocated")
             self._pending.add(session_id)
             self._enqueued_bytes += nbytes
@@ -202,6 +229,7 @@ class KVArena:
                 while self.max_bytes - self._used_bytes < nbytes:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0 or not self._lock.wait(remaining):
+                        self._m_alloc_failures.inc()
                         raise AllocationFailed(
                             f"arena full: {self._used_bytes}/{self.max_bytes} "
                             f"bytes used, need {nbytes}, timed out after "
@@ -213,6 +241,9 @@ class KVArena:
                 raise
             finally:
                 self._enqueued_bytes -= nbytes
+            self._m_alloc_wait.observe(time.monotonic() - t_alloc)
+            self._m_allocs.inc()
+            self._publish_occupancy()
 
         try:
             shape = (layers, batch, bucket_len, self.num_kv_heads, self.head_dim)
@@ -231,6 +262,8 @@ class KVArena:
                 self._used_bytes -= nbytes
                 self._pending.discard(session_id)
                 self._lock.notify_all()
+                self._m_alloc_failures.inc()
+                self._publish_occupancy()
             raise
         handle = KVHandle(
             session_id=session_id,
@@ -274,6 +307,7 @@ class KVArena:
             handle.nbytes += delta
             if delta < 0:
                 self._lock.notify_all()
+            self._publish_occupancy()
             return handle
 
     def get(self, session_id: str) -> Optional[KVHandle]:
@@ -290,6 +324,7 @@ class KVArena:
             handle.v = None  # type: ignore[assignment]
             self._used_bytes -= handle.nbytes
             self._lock.notify_all()
+            self._publish_occupancy()
 
     @contextmanager
     def session(self, session_id: str, max_length: int, timeout: Optional[float] = None):
@@ -316,6 +351,8 @@ class KVArena:
             ]
         for sid in stale:
             self.free(sid)
+        if stale:
+            self._m_evictions.inc(len(stale))
         return len(stale)
 
     def active_sessions(self) -> Tuple[str, ...]:

@@ -107,6 +107,12 @@ class StageRequest:
     # and registers them on a miss. 0 = no sharing; servers without a store
     # ignore the field, so clients annotate unconditionally.
     prefix_len: int = 0
+    # Trace context (telemetry.tracing, Dapper-style):
+    # {"trace_id": <16 hex>, "parent": <client hop span_id>, "hop": <int>}.
+    # None = tracing off / legacy client; servers must treat it as opaque
+    # pass-through (push-chain relays propagate it unchanged so every hop of
+    # a chain lands in the same trace).
+    trace: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -157,6 +163,12 @@ class StageResponse:
     # rewinding past the rejected tail.
     tokens: Optional[Tuple[int, ...]] = None
     n_accepted: Optional[int] = None
+    # Server-side span summary for the request's trace (telemetry.tracing
+    # Span.to_wire()): the serving peer's own wall-clock start/end plus attrs
+    # (peer id, blocks). None when the request carried no trace. On a push
+    # chain the relayed final response keeps the FINAL hop's span — each
+    # intermediate hop still records its span into its local tracer.
+    span: Optional[dict] = None
 
     @property
     def is_token(self) -> bool:

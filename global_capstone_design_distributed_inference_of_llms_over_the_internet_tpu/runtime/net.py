@@ -47,6 +47,10 @@ import numpy as np
 from .. import native
 from ..ops.sampling import SamplingParams
 from ..scheduling.registry import PlacementRegistry, ServerRecord
+from ..telemetry import catalog as _tm
+from ..telemetry import exposition as _texp
+from ..telemetry import get_registry as _get_metrics_registry
+from ..telemetry import get_tracer
 from .executor import StageExecutionError, StageExecutor
 from .messages import BackwardRequest, StageRequest, StageResponse
 from .task_pool import StageRuntime, TaskRejected
@@ -272,6 +276,10 @@ def _request_header(req: StageRequest, tensor_meta: dict,
         # Prompt-prefix sharing marker (runtime.prefix_cache); absent for
         # the common case so legacy peers see byte-identical headers.
         hdr["prefix_len"] = req.prefix_len
+    if req.trace is not None:
+        # Trace context (telemetry.tracing): absent unless the client runs
+        # with tracing on, so legacy peers see byte-identical headers.
+        hdr["trace"] = req.trace
     # Model identity echo: the data-plane counterpart of the reference's
     # model-prefixed DHT keys (src/dht_utils.py:20-31). A mis-routed request
     # (wrong model's server) must fail loudly, not produce garbage activations.
@@ -317,6 +325,7 @@ def _header_to_request(h: dict, payload: bytes) -> StageRequest:
         model=h.get("model"),
         prompts=pr,
         prefix_len=h.get("prefix_len", 0),
+        trace=h.get("trace"),
     )
 
 
@@ -628,6 +637,16 @@ class TcpStageServer(_FramedTcpServer):
             # still answers reachability votes for its peers.
             self._reach_check(sock, header)
             return
+        if verb == "metrics":
+            # Prometheus-text scrape of this PROCESS's registry. Needs no
+            # executor (a re-spanning server still answers scrapes); empty
+            # output when telemetry is disabled — the scrape itself never
+            # enables collection.
+            _send_frame(sock, {
+                "verb": "metrics",
+                "text": _texp.render(_get_metrics_registry()),
+            })
+            return
         # Snapshot: the elastic rebalance thread may null/swap self.executor
         # at any moment; every later access in this request must see ONE
         # consistent executor (a mid-request swap would otherwise surface as
@@ -712,6 +731,10 @@ class TcpStageServer(_FramedTcpServer):
             # operator's first question about a misbehaving server is "what
             # has it been serving" — answerable over the wire.
             frame["recent_requests"] = self.request_log.tail(20)
+            # One-line telemetry aggregate (steps/s, p50/p95 step latency,
+            # cache hit rate) for --mode status; None-valued fields when
+            # telemetry is off or no traffic has been observed yet.
+            frame["telemetry"] = _texp.summary(_get_metrics_registry())
             _send_frame(sock, frame)
         else:
             _send_frame(sock, {"verb": "error",
@@ -806,6 +829,7 @@ class TcpStageServer(_FramedTcpServer):
             next_servers=state["next_servers"],
             start_from_position=header.get("start_from_position"),
             prefix_len=header.get("prefix_len", 0),
+            trace=header.get("trace"),
         )
         self._run_forward(sock, ex, req, stream=state,
                           step_timeout=state["step_timeout"])
@@ -817,6 +841,14 @@ class TcpStageServer(_FramedTcpServer):
         if resp_wire_dtype is None and stream is not None:
             resp_wire_dtype = stream.get("wire_dtype")
         resp_wire_dtype = resp_wire_dtype or self.wire_dtype
+        # Serving-boundary telemetry: THIS is where a request's server-side
+        # step latency is defined (queue wait through response encode), so
+        # the step histogram/token counters live here, not in the executor.
+        phase = "prefill" if req.is_prefill else "decode"
+        m_requests = _tm.get("server_requests_total")
+        span = get_tracer().span_from_wire(
+            req.trace, "server_forward", kind="server",
+            peer=ex.peer_id, phase=phase)
 
         def _log(outcome, detail=None):
             try:
@@ -843,6 +875,8 @@ class TcpStageServer(_FramedTcpServer):
         # would otherwise silently drop the connection.
         except (StageExecutionError, TaskRejected) as exc:
             _log("stage_error", str(exc))
+            m_requests.labels(outcome="error").inc()
+            span.end(error=repr(exc))
             _send_frame(sock, {"verb": "error", "message": str(exc),
                                "kind": "stage",
                                "peer": ex.peer_id})
@@ -851,11 +885,17 @@ class TcpStageServer(_FramedTcpServer):
             budget = (step_timeout if step_timeout is not None
                       else self.compute_timeout)
             _log("timeout")
+            m_requests.labels(outcome="timeout").inc()
+            span.end(error="timeout")
             _send_frame(sock, {"verb": "error", "kind": "stage",
                                "peer": ex.peer_id,
                                "message": f"stage compute timed out after "
                                           f"{budget:.0f}s"})
             return
+        # End the server span at compute completion (its to_wire summary
+        # rides the response so the CLIENT records both sides of the hop).
+        span.set(cache_len=resp.cache_len).end()
+        wire_span = span.to_wire() if req.trace is not None else None
         if resp.is_token:
             if stream is not None and resp.token_id is not None:
                 # Maintain the stream's server-side recent-token window
@@ -868,21 +908,29 @@ class TcpStageServer(_FramedTcpServer):
             }
             if resp.token_ids is not None:   # batch>1 per-row sampling
                 frame["token_ids"] = list(resp.token_ids)
+            if wire_span is not None:
+                frame["span"] = wire_span
             _send_frame(sock, frame)
         elif resp.is_speculative:
-            _send_frame(sock, {
+            frame = {
                 "verb": "spec", "session_id": resp.session_id,
                 "tokens": list(resp.tokens),
                 "n_accepted": resp.n_accepted,
                 "cache_len": resp.cache_len,
-            })
+            }
+            if wire_span is not None:
+                frame["span"] = wire_span
+            _send_frame(sock, frame)
         elif resp.is_beam:
-            _send_frame(sock, {
+            frame = {
                 "verb": "beam", "session_id": resp.session_id,
                 "cache_len": resp.cache_len,
                 "top_tokens": [list(r) for r in resp.top_tokens],
                 "top_logprobs": [list(r) for r in resp.top_logprobs],
-            })
+            }
+            if wire_span is not None:
+                frame["span"] = wire_span
+            _send_frame(sock, frame)
         elif req.next_servers:
             # Push chain (petals handler.py:320-350): ship our output
             # straight to the next hop and relay its final response back
@@ -898,6 +946,7 @@ class TcpStageServer(_FramedTcpServer):
             try:
                 rh, rp = self._relay(nxt, nreq)
             except (ConnectionError, OSError, TimeoutError) as exc:
+                m_requests.labels(outcome="error").inc()
                 _send_frame(sock, {
                     "verb": "error", "kind": "push",
                     "peer": nxt.get("peer_id", "?"),
@@ -916,10 +965,13 @@ class TcpStageServer(_FramedTcpServer):
         else:
             arr = np.asarray(resp.hidden)
             meta, body = _encode_tensor(arr, resp_wire_dtype)
-            _send_frame(sock, {
+            frame = {
                 "verb": "hidden", "session_id": resp.session_id,
                 "cache_len": resp.cache_len, "tensor": meta,
-            }, body)
+            }
+            if wire_span is not None:
+                frame["span"] = wire_span
+            _send_frame(sock, frame, body)
         # Structured per-request record (petals _log_request,
         # handler.py:549-573 parity, exceeded: RequestLog also keeps the
         # bounded ring the info verb surfaces, and errors are recorded at
@@ -928,6 +980,10 @@ class TcpStageServer(_FramedTcpServer):
         # work for hidden-returning stages actually materialized — dur_ms
         # covers real compute, not dispatch. Decode-ok records go to the
         # logger at DEBUG so steady-state serving doesn't flood logs.
+        _tm.get("server_step_latency_seconds").labels(
+            phase=phase).observe(time.monotonic() - t_req)
+        _tm.get("server_tokens_total").labels(phase=phase).inc(req.seq_len)
+        m_requests.labels(outcome="ok").inc()
         _log("ok")
 
     def _train_verbs(self, sock, ex, verb: str, header: dict,
@@ -1060,6 +1116,13 @@ class TcpTransport(Transport):
         # (peer_id, session_id) -> {"snap", "sock", "window", "returns_tokens"}
         self._streams: Dict[Tuple[str, str], dict] = {}
         self._lock = threading.Lock()
+        # Wire telemetry (global registry; no-op unless enabled). Byte
+        # counters cover tensor payloads, not frame/header overhead —
+        # consistent with LocalTransport's accounting.
+        self._m_calls = _tm.get("transport_calls_total")
+        self._m_sent = _tm.get("transport_bytes_sent_total")
+        self._m_recv = _tm.get("transport_bytes_received_total")
+        self._m_rtt = _tm.get("transport_rtt_seconds")
 
     def _tagged(self, hdr: dict) -> dict:
         """Stamp the client's model identity on an outgoing request header.
@@ -1125,7 +1188,9 @@ class TcpTransport(Transport):
         try:
             t0 = time.perf_counter()
             self.info(peer_id, timeout=3.0)
-            return time.perf_counter() - t0
+            rtt = time.perf_counter() - t0
+            self._m_rtt.observe(rtt)
+            return rtt
         except (PeerUnavailable, TimeoutError, ConnectionError, OSError):
             return None
 
@@ -1143,6 +1208,8 @@ class TcpTransport(Transport):
         if self._streamable(request):
             return self._call_stream(peer_id, request, timeout)
         sock = self._connect(peer_id)
+        self._m_calls.labels(
+            verb="train" if request.train else "forward").inc()
         try:
             sock.settimeout(timeout)
             if request.train:
@@ -1199,7 +1266,9 @@ class TcpTransport(Transport):
                 # bf16-default server.
                 hdr["wire_dtype"] = self.wire_dtype
                 _send_frame(sock, self._tagged(hdr), body)
+            self._m_sent.inc(len(body))
             header, payload = _recv_frame(sock)
+            self._m_recv.inc(len(payload))
         except socket.timeout as exc:
             self._drop(peer_id)
             raise TimeoutError(f"peer {peer_id} timed out") from exc
@@ -1269,6 +1338,8 @@ class TcpTransport(Transport):
                     hdr["prefix_len"] = request.prefix_len
             if request.start_from_position is not None:
                 hdr["start_from_position"] = request.start_from_position
+            if request.trace is not None:
+                hdr["trace"] = request.trace
             if st["returns_tokens"] and (
                     st["window"] != list(request.generated_tokens)[-50:]):
                 # Window drifted (tokens were produced off-stream): re-seed
@@ -1282,8 +1353,11 @@ class TcpTransport(Transport):
             arr = np.asarray(request.hidden)
             meta, body = _encode_tensor(arr, self.wire_dtype)
             hdr["tensor"] = meta
+            self._m_calls.labels(verb="step").inc()
             _send_frame(sock, hdr, body)
+            self._m_sent.inc(len(body))
             header, payload = _recv_frame(sock)
+            self._m_recv.inc(len(payload))
         except socket.timeout as exc:
             self._drop(peer_id)
             raise TimeoutError(f"peer {peer_id} timed out") from exc
@@ -1314,12 +1388,16 @@ class TcpTransport(Transport):
     def _parse_response(self, peer_id: str, header: dict,
                         payload: bytes) -> StageResponse:
         verb = header.get("verb")
+        # Server-side span summary (telemetry.tracing): present only when
+        # the request carried a trace context.
+        span = header.get("span")
         if verb == "spec":
             return StageResponse(
                 session_id=header["session_id"],
                 tokens=tuple(header["tokens"]),
                 n_accepted=header["n_accepted"],
                 cache_len=header["cache_len"],
+                span=span,
             )
         if verb == "token":
             ids = header.get("token_ids")
@@ -1328,6 +1406,7 @@ class TcpTransport(Transport):
                 token_id=header["token_id"],
                 token_ids=None if ids is None else tuple(ids),
                 cache_len=header["cache_len"],
+                span=span,
             )
         if verb == "beam":
             return StageResponse(
@@ -1335,12 +1414,14 @@ class TcpTransport(Transport):
                 cache_len=header["cache_len"],
                 top_tokens=tuple(tuple(r) for r in header["top_tokens"]),
                 top_logprobs=tuple(tuple(r) for r in header["top_logprobs"]),
+                span=span,
             )
         if verb == "hidden":
             return StageResponse(
                 session_id=header["session_id"],
                 hidden=jnp.asarray(_decode_tensor(header["tensor"], payload)),
                 cache_len=header["cache_len"],
+                span=span,
             )
         if verb == "error":
             if header.get("kind") == "push":
@@ -1436,6 +1517,22 @@ class TcpTransport(Transport):
         except (ConnectionError, OSError) as exc:
             self._drop(peer_id)
             raise PeerUnavailable(f"peer {peer_id}: {exc}")
+
+    def metrics_text(self, peer_id: str, timeout: float = 5.0) -> str:
+        """Prometheus-text scrape of a peer's process registry (the
+        ``metrics`` verb). Empty string when the peer runs telemetry off."""
+        sock = self._connect(peer_id)
+        try:
+            sock.settimeout(timeout)
+            _send_frame(sock, {"verb": "metrics"})
+            header, _ = _recv_frame(sock)
+        except (ConnectionError, OSError) as exc:
+            self._drop(peer_id)
+            raise PeerUnavailable(f"peer {peer_id}: {exc}")
+        if header.get("verb") != "metrics":
+            raise WireError(
+                f"unexpected response verb {header.get('verb')!r}")
+        return header.get("text", "")
 
     def reach_check(self, peer_id: str, target: str,
                     timeout: float = 8.0) -> bool:

@@ -60,6 +60,8 @@ from typing import List, Optional
 
 import jax.numpy as jnp
 
+from ..telemetry import catalog as _tm
+
 # Tokens per cached segment. Smaller = finer shared-prefix matching but
 # more entries and more copy calls per hit; 64 keeps a segment's KV write
 # one cheap dynamic_update_slice while matching system prompts closely.
@@ -101,6 +103,14 @@ class PrefixStore:
         self.misses = 0        # lookups that reused none
         self.grains_reused = 0
         self.evictions = 0
+        # Registry mirrors of the counters above (process-global telemetry;
+        # no-op unless enabled). The ints stay authoritative for ``stats()``
+        # — the info verb must work with telemetry off.
+        self._m_hits = _tm.get("server_prefix_cache_hits_total")
+        self._m_misses = _tm.get("server_prefix_cache_misses_total")
+        self._m_evictions = _tm.get("server_prefix_cache_evictions_total")
+        self._m_grains = _tm.get("server_prefix_cache_grains_reused_total")
+        self._m_bytes = _tm.get("server_prefix_cache_used_bytes")
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -128,8 +138,11 @@ class PrefixStore:
             if got:
                 self.hits += 1
                 self.grains_reused += len(got)
+                self._m_hits.inc()
+                self._m_grains.inc(len(got))
             elif keys:
                 self.misses += 1
+                self._m_misses.inc()
         return got
 
     def put(self, key: str, k: jnp.ndarray, v: jnp.ndarray,
@@ -149,8 +162,10 @@ class PrefixStore:
                 _, victim = self._entries.popitem(last=False)
                 self.used_bytes -= victim.nbytes
                 self.evictions += 1
+                self._m_evictions.inc()
             self._entries[key] = entry
             self.used_bytes += nbytes
+            self._m_bytes.set(self.used_bytes)
         return True
 
     def stats(self) -> dict:

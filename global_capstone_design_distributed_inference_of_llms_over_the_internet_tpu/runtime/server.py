@@ -40,6 +40,7 @@ from ..scheduling.registry import (
     ServerState,
 )
 from ..scheduling.throughput import get_server_throughput
+from ..telemetry import catalog as _tm
 from .executor import StageExecutor
 from .transport import LocalTransport, Transport
 
@@ -376,6 +377,7 @@ class ElasticStageServer:
             next_server_rtts=self._published_rtts(),
         ):
             self.registry.register(self._record())
+        _tm.get("server_heartbeats_total").inc()
         self.ping_next_servers()
 
     def _published_rtts(self) -> Optional[Dict[str, float]]:
@@ -427,6 +429,7 @@ class ElasticStageServer:
             self.load_span(old_spec)
             return False
         self.rebalances += 1
+        _tm.get("server_rebalances_total").inc()
         return True
 
     def next_check_delay(self) -> float:
@@ -553,6 +556,7 @@ class FixedStageServer:
             next_server_rtts=self._published_rtts(),
         ):
             self.registry.register(self._record())  # self-heal after expiry
+        _tm.get("server_heartbeats_total").inc()
         self.ping_next_servers()
 
     def shutdown(self) -> None:

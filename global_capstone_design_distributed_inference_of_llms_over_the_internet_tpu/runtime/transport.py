@@ -30,6 +30,8 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ..telemetry import catalog as _tm
+from ..telemetry import get_tracer
 from .executor import StageExecutor
 from .messages import (
     BackwardRequest,
@@ -113,6 +115,18 @@ class LocalTransport(Transport):
         # ping()/measure_next_server_rtts — the in-process stand-in for real
         # wire RTTs.
         self.rtts: Dict[str, float] = {}
+        # Telemetry (global registry/tracer; strict no-op unless enabled).
+        # LocalTransport IS the serving boundary for in-process peers, so it
+        # owns the server-side step latency/tokens/outcome metrics and the
+        # kind="server" span — the same signals TcpStageServer records for
+        # real sockets. Bytes are tensor nbytes (no frame overhead here).
+        self._m_calls = _tm.get("transport_calls_total")
+        self._m_sent = _tm.get("transport_bytes_sent_total")
+        self._m_recv = _tm.get("transport_bytes_received_total")
+        self._m_rtt = _tm.get("transport_rtt_seconds")
+        self._m_step = _tm.get("server_step_latency_seconds")
+        self._m_tokens = _tm.get("server_tokens_total")
+        self._m_requests = _tm.get("server_requests_total")
 
     # -- membership ---------------------------------------------------------
 
@@ -161,7 +175,9 @@ class LocalTransport(Transport):
         if not self.alive(peer_id):
             return None
         with self._lock:
-            return self.rtts.get(peer_id, 0.0)
+            rtt = self.rtts.get(peer_id, 0.0)
+        self._m_rtt.observe(rtt)
+        return rtt
 
     def end_session(self, peer_id: str, session_id: str) -> None:
         with self._lock:
@@ -193,9 +209,35 @@ class LocalTransport(Transport):
                     f"peer {peer_id} timed out after {timeout:.1f}s (stalled)"
                 )
             time.sleep(stall)
+        phase = ("train" if request.train
+                 else "prefill" if request.is_prefill else "decode")
+        self._m_calls.labels(verb="forward").inc()
+        if request.hidden is not None:
+            self._m_sent.inc(int(getattr(request.hidden, "nbytes", 0)))
+        span = get_tracer().span_from_wire(
+            request.trace, "server_forward", kind="server", peer=peer_id,
+            phase=phase)
+        t0 = time.monotonic()
+        try:
+            if request.train:
+                resp = executor.train_forward(request)
+            else:
+                resp = executor.forward(request)
+        except BaseException as exc:
+            self._m_requests.labels(outcome="error").inc()
+            span.end(error=repr(exc))
+            raise
+        dur = time.monotonic() - t0
+        self._m_step.labels(phase=phase).observe(dur)
+        self._m_tokens.labels(phase=phase).inc(request.seq_len)
+        self._m_requests.labels(outcome="ok").inc()
+        span.set(cache_len=getattr(resp, "cache_len", 0)).end()
+        if resp.hidden is not None:
+            self._m_recv.inc(int(resp.hidden.nbytes))
+        if request.trace is not None and hasattr(resp, "span"):
+            resp.span = span.to_wire()
         if request.train:
-            return executor.train_forward(request)
-        resp = executor.forward(request)
+            return resp
         if request.next_servers and resp.hidden is not None:
             # Push chain: forward the output straight to the next hop and
             # relay its (eventual final) response. Downstream failures are
@@ -229,4 +271,5 @@ class LocalTransport(Transport):
             dead = self._dead.get(peer_id, True)
         if executor is None or dead:
             raise PeerUnavailable(f"peer {peer_id} is not reachable")
+        self._m_calls.labels(verb="backward").inc()
         return executor.backward(request)

@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..telemetry import catalog as _tm
 from .registry import ServerRecord, ServerState
 
 EPS = 1e-3
@@ -152,7 +153,9 @@ def should_choose_other_blocks(
 
     balance_quality > 1.0 forces True (debugging hook, both variants).
     """
+    _tm.get("scheduler_rebalance_checks_total").inc()
     if balance_quality > 1.0:
+        _tm.get("scheduler_rebalance_moves_total").inc()
         return True
     rng = rng or np.random.default_rng()
 
@@ -219,4 +222,7 @@ def should_choose_other_blocks(
     if new < initial or new < EPS:
         return False
     quality = initial / new
-    return quality < balance_quality - EPS
+    move = quality < balance_quality - EPS
+    if move:
+        _tm.get("scheduler_rebalance_moves_total").inc()
+    return move

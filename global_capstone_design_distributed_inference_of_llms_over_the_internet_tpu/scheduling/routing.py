@@ -34,6 +34,7 @@ from __future__ import annotations
 import heapq
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
+from ..telemetry import catalog as _tm
 from .registry import ServerRecord, ServerState
 
 DEFAULT_RTT = 0.05  # seconds; unmeasured link penalty (WAN-scale, not free)
@@ -137,6 +138,8 @@ def plan_min_latency_route(
         hops.append(RouteHop(rec, prev_state[0], state[0]))
         state = prev_state
     hops.reverse()
+    _tm.get("scheduler_route_plans_total").labels(planner="latency").inc()
+    _tm.get("scheduler_route_hops").observe(len(hops))
     return hops
 
 

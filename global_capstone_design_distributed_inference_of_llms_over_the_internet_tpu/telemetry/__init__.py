@@ -1,0 +1,60 @@
+"""Swarm telemetry: metrics registry, request tracing, Prometheus exposition.
+
+Dependency-free (no prometheus_client, no opentelemetry — the container does
+not grow packages). Three layers:
+
+  * `metrics`   — counters / gauges / fixed-bucket histograms in a thread-safe
+                  registry; strict no-op when disabled.
+  * `tracing`   — Dapper-style spans carried through the stage wire protocol.
+  * `exposition`— Prometheus text rendering + the compact per-server summary
+                  the ``info``/``status`` path embeds.
+
+The process-global registry and tracer start DISABLED; `enable()` (wired to
+``--telemetry`` in main.py) flips both and materializes the full metric schema
+so a scrape always shows every family.
+
+Components that must meter regardless of the global flag (PipelineClient —
+its `recoveries` counter is load-bearing API) own a private always-enabled
+`MetricsRegistry` instead.
+"""
+
+from .catalog import SPEC, all_names, get, register_all
+from .exposition import render, summary
+from .metrics import (
+    COUNTER,
+    DEFAULT_LATENCY_BUCKETS,
+    GAUGE,
+    HISTOGRAM,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from .tracing import NOOP_SPAN, Span, Tracer, get_tracer, new_id, reconstruct
+
+
+def enabled() -> bool:
+    return get_registry().enabled
+
+
+def enable() -> None:
+    """Turn on process-wide telemetry: metrics + tracing, full schema."""
+    get_registry().enable()
+    get_tracer().set_enabled(True)
+    register_all(get_registry())
+
+
+def disable() -> None:
+    get_registry().disable()
+    get_tracer().set_enabled(False)
+
+
+__all__ = [
+    "COUNTER", "GAUGE", "HISTOGRAM", "DEFAULT_LATENCY_BUCKETS",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "NOOP_SPAN", "Span", "Tracer", "get_tracer", "new_id", "reconstruct",
+    "SPEC", "all_names", "get", "register_all",
+    "render", "summary",
+    "enable", "disable", "enabled",
+]

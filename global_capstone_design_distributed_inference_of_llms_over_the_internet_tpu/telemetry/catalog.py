@@ -1,0 +1,167 @@
+"""Canonical catalog of every runtime metric: the ONE place names, label
+sets, help strings, and bucket layouts are declared.
+
+Instrumentation sites fetch metrics through this module (never by calling
+``registry.counter(...)`` with an inline name), which buys three properties:
+
+  * a typo'd metric name is a KeyError at import/first-use, not a silently
+    forked time series;
+  * ``register_all()`` can materialize the full schema on any registry — the
+    exposition surface shows every family (zero-valued included) and
+    ``scripts/check_metrics_documented.py`` can diff the schema against
+    docs/OBSERVABILITY.md;
+  * docs and code cannot drift without a tier-1 test failing.
+
+All helpers operate on the process-global registry by default (disabled until
+``telemetry.enable()``), and accept an explicit registry for components that
+own one (PipelineClient) and for tests.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+from .metrics import (
+    COUNTER,
+    DEFAULT_LATENCY_BUCKETS,
+    GAUGE,
+    HISTOGRAM,
+    MetricsRegistry,
+    get_registry,
+)
+
+# Sub-second work (single decode hops, queue waits): 0.1 ms .. 10 s.
+FAST_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0,
+)
+# Batch occupancy (sessions coalesced per decode round).
+FILL_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 64.0)
+# Route lengths (hops per planned pipeline).
+HOP_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)
+
+# name -> (kind, help, label names, histogram buckets or None)
+SPEC: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Sequence[float]]]] = {
+    # -- server hot path ----------------------------------------------------
+    "server_step_latency_seconds": (
+        HISTOGRAM, "Stage forward latency at the serving boundary, per phase.",
+        ("phase",), FAST_BUCKETS),
+    "server_queue_wait_seconds": (
+        HISTOGRAM,
+        "Time a session waited for its batching round to execute.",
+        (), FAST_BUCKETS),
+    "server_batch_fill_sessions": (
+        HISTOGRAM, "Sessions coalesced into one batched decode round.",
+        (), FILL_BUCKETS),
+    "server_decode_round_seconds": (
+        HISTOGRAM, "Wall time of one batched decode round (all slots).",
+        (), FAST_BUCKETS),
+    "server_tokens_total": (
+        COUNTER, "Tokens processed by this stage, per phase.",
+        ("phase",), None),
+    "server_requests_total": (
+        COUNTER, "Stage requests served, per outcome (ok|error).",
+        ("outcome",), None),
+    # -- KV arena -----------------------------------------------------------
+    "server_kv_used_bytes": (
+        GAUGE, "KV arena bytes currently leased.", (), None),
+    "server_kv_capacity_bytes": (
+        GAUGE, "KV arena byte budget.", (), None),
+    "server_kv_occupancy_ratio": (
+        GAUGE, "KV arena used/capacity (0..1).", (), None),
+    "server_kv_alloc_total": (
+        COUNTER, "KV session leases granted.", (), None),
+    "server_kv_alloc_failures_total": (
+        COUNTER, "KV allocations refused (arena full past timeout, "
+                 "oversized, or duplicate session).", (), None),
+    "server_kv_alloc_wait_seconds": (
+        HISTOGRAM, "Backpressure: time an allocation waited for free space.",
+        (), FAST_BUCKETS),
+    "server_kv_evictions_total": (
+        COUNTER, "Idle sessions evicted by the arena backstop.", (), None),
+    # -- prefix cache -------------------------------------------------------
+    "server_prefix_cache_hits_total": (
+        COUNTER, "Prefill prefix lookups served from the store.", (), None),
+    "server_prefix_cache_misses_total": (
+        COUNTER, "Prefill prefix lookups that missed.", (), None),
+    "server_prefix_cache_evictions_total": (
+        COUNTER, "Prefix grains evicted (LRU byte budget).", (), None),
+    "server_prefix_cache_grains_reused_total": (
+        COUNTER, "Individual KV grains spliced from the store.", (), None),
+    "server_prefix_cache_used_bytes": (
+        GAUGE, "Prefix store resident bytes.", (), None),
+    # -- elastic server control loop ----------------------------------------
+    "server_heartbeats_total": (
+        COUNTER, "Registry heartbeats published.", (), None),
+    "server_rebalances_total": (
+        COUNTER, "Span migrations executed by the elastic server.", (), None),
+    # -- client -------------------------------------------------------------
+    "client_ttft_seconds": (
+        HISTOGRAM, "Time to first token (prefill walk + first sample).",
+        (), DEFAULT_LATENCY_BUCKETS),
+    "client_step_seconds": (
+        HISTOGRAM, "Whole-pipeline decode step wall time, client view.",
+        (), FAST_BUCKETS),
+    "client_stage_time_seconds": (
+        HISTOGRAM, "Per-hop wall time observed by the client, per phase.",
+        ("hop", "phase"), FAST_BUCKETS),
+    "client_retries_total": (
+        COUNTER, "Hop attempts beyond the first (recovery retry loop).",
+        (), None),
+    "client_recoveries_total": (
+        COUNTER, "Successful failovers to a replacement server.", (), None),
+    "client_generations_total": (
+        COUNTER, "generate() calls completed.", (), None),
+    "client_tokens_generated_total": (
+        COUNTER, "Tokens emitted to callers.", (), None),
+    # -- transport ----------------------------------------------------------
+    "transport_calls_total": (
+        COUNTER, "Transport round trips, per verb.", ("verb",), None),
+    "transport_bytes_sent_total": (
+        COUNTER, "Payload bytes sent to peers (tensor bytes for the "
+                 "in-process transport, frame bytes for TCP).", (), None),
+    "transport_bytes_received_total": (
+        COUNTER, "Payload bytes received from peers.", (), None),
+    "transport_rtt_seconds": (
+        HISTOGRAM, "Measured ping round-trip time.", (), FAST_BUCKETS),
+    # -- scheduler ----------------------------------------------------------
+    "scheduler_route_plans_total": (
+        COUNTER, "Route computations, per planner (greedy|latency).",
+        ("planner",), None),
+    "scheduler_route_hops": (
+        HISTOGRAM, "Hops in each planned route.", (), HOP_BUCKETS),
+    "scheduler_rebalance_checks_total": (
+        COUNTER, "should_choose_other_blocks evaluations.", (), None),
+    "scheduler_rebalance_moves_total": (
+        COUNTER, "Rebalance checks that recommended moving.", (), None),
+}
+
+
+def all_names() -> Tuple[str, ...]:
+    return tuple(sorted(SPEC))
+
+
+def get(name: str, registry: Optional[MetricsRegistry] = None):
+    """Fetch (creating on first use) the named metric from `registry` (global
+    by default). Labeled families return the `.labels(...)` facade."""
+    try:
+        kind, help_text, labels, buckets = SPEC[name]
+    except KeyError:
+        raise KeyError(f"metric {name!r} is not in the telemetry catalog")
+    reg = registry if registry is not None else get_registry()
+    if kind == COUNTER:
+        return reg.counter(name, help_text, labels=labels)
+    if kind == GAUGE:
+        return reg.gauge(name, help_text, labels=labels)
+    return reg.histogram(name, help_text,
+                         buckets=buckets or DEFAULT_LATENCY_BUCKETS,
+                         labels=labels)
+
+
+def register_all(registry: Optional[MetricsRegistry] = None) -> MetricsRegistry:
+    """Materialize every catalogued family on `registry` so exposition shows
+    the complete schema even before traffic."""
+    reg = registry if registry is not None else get_registry()
+    for name in all_names():
+        get(name, reg)
+    return reg

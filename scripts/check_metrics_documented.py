@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Fail (exit 1) when telemetry catalog and docs/OBSERVABILITY.md drift.
+
+Two directions:
+
+  * every metric in ``telemetry.catalog.SPEC`` must appear (backticked) in
+    docs/OBSERVABILITY.md — new instrumentation cannot ship undocumented;
+  * every backticked ``server_*``/``client_*``/``transport_*``/
+    ``scheduler_*`` metric-shaped name in the doc must exist in the catalog
+    — stale docs cannot describe metrics that no longer exist.
+
+Pure stdlib + the dependency-free telemetry package (no jax import), so the
+check is fast enough to run as a tier-1 test
+(tests/test_metrics_documented.py).
+"""
+
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.telemetry.catalog import (  # noqa: E402
+    SPEC,
+    all_names,
+)
+
+DOC = REPO / "docs" / "OBSERVABILITY.md"
+
+# Backticked tokens that look like catalog metrics. The suffix alternation
+# keeps prose like `server_forward` (a span name) out of scope.
+_DOC_METRIC_RE = re.compile(
+    r"`((?:server|client|transport|scheduler)_[a-z0-9_]+"
+    r"(?:_total|_seconds|_bytes|_ratio|_sessions|_hops))`"
+)
+
+
+def main() -> int:
+    if not DOC.exists():
+        print(f"missing {DOC.relative_to(REPO)}")
+        return 1
+    text = DOC.read_text(encoding="utf-8")
+
+    undocumented = [n for n in all_names() if f"`{n}`" not in text]
+    unknown = sorted(
+        {m for m in _DOC_METRIC_RE.findall(text) if m not in SPEC}
+    )
+
+    if undocumented:
+        print("metrics in telemetry/catalog.py missing from "
+              "docs/OBSERVABILITY.md:")
+        for n in undocumented:
+            print(f"  {n}")
+    if unknown:
+        print("metric names documented in docs/OBSERVABILITY.md but absent "
+              "from telemetry/catalog.py:")
+        for n in unknown:
+            print(f"  {n}")
+    if undocumented or unknown:
+        return 1
+    print(f"ok: {len(all_names())} metrics documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,18 @@
+"""Tier-1 wrapper for scripts/check_metrics_documented.py: the telemetry
+catalog and docs/OBSERVABILITY.md must not drift in either direction."""
+
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_every_metric_documented():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_metrics_documented.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"metric/doc drift:\n{proc.stdout}{proc.stderr}"
+    )
