@@ -1029,6 +1029,69 @@ def bench_telemetry_overhead(step_ms_ref: float, iters=20000, reps=5):
     }
 
 
+def bench_recorder_overhead(step_ms_ref: float, iters=20000, reps=5):
+    """Flight-recorder acceptance row: emitting events must cost <1% of a
+    fused decode step, disabled AND enabled.
+
+    A decode step on the happy path emits NO events — the recorder records
+    decisions (retries, failovers, evictions), not steps. The honest
+    per-step price is therefore the disabled fast path at every instrument
+    site a step passes; the enabled number below prices a pessimistic
+    3-emits-per-step workload (what a step inside an incident pays), ring
+    append + catalog lookup + dict build included. Same methodology as
+    bench_telemetry_overhead: private recorder, best-of-reps, priced
+    against the measured fused step."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.telemetry.events import (
+        EventRecorder,
+    )
+
+    def build(enabled: bool):
+        rec = EventRecorder(capacity=4096, enabled=enabled)
+
+        def one_step():
+            # The disabled path all instrument sites pay, x3 (a step
+            # crosses client, transport, and server sites); enabled, the
+            # same three sites actually append.
+            rec.emit("hop_retry", session_id="s", trace_id="t",
+                     hop="stage1", peer="p0", attempt=2)
+            rec.emit("transport_timeout", session_id="s", trace_id="t",
+                     peer="p0")
+            rec.emit("queue_pressure", pool="inference", level="high",
+                     depth=16)
+
+        return one_step
+
+    def time_it(fn):
+        fn()  # warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fn()
+            best = min(best, time.perf_counter() - t0)
+        return best / iters
+
+    t_off = time_it(build(False))
+    t_on = time_it(build(True))
+    ref_s = step_ms_ref / 1e3
+    return {
+        "emits_per_step": 3,
+        "disabled_us_per_step": round(t_off * 1e6, 3),
+        "enabled_us_per_step": round(t_on * 1e6, 3),
+        "fused_step_ms_ref": round(step_ms_ref, 3),
+        "overhead_pct_disabled": round(t_off / ref_s * 100, 4),
+        "overhead_pct_enabled": round(t_on / ref_s * 100, 4),
+        "pass_lt_1pct_disabled": bool(t_off / ref_s < 0.01),
+        "pass_lt_1pct_enabled": bool(t_on / ref_s < 0.01),
+        "note": ("host-side microbench of 3 flight-recorder emits "
+                 "(ring append under lock, catalog lookup, timestamping) "
+                 "vs the disabled one-flag-check path, priced against the "
+                 "measured fused step; a happy-path step emits zero "
+                 "events, so 3/step is the incident-path pessimistic "
+                 "bound"),
+    }
+
+
 def _device_reachable(timeout_s: float = 90.0) -> bool:
     """Probe backend init in a SUBPROCESS: a wedged axon tunnel hangs
     jax.devices() indefinitely, which would turn the driver's bench run
@@ -1194,8 +1257,10 @@ def main():
         rp = bench_prefill(cfg, params, batch=2, seq=32, n1=2, n2=8, reps=1)
         rpx = bench_prefix_cache(cfg, params, seq=96, suffix=16, reps=2)
         rt = bench_telemetry_overhead(r["step_ms"])
+        rrec = bench_recorder_overhead(r["step_ms"])
         cfgs = {"smoke": r, "smoke_serving": rs, "smoke_prefill": rp,
-                "smoke_prefix_cache": rpx, "smoke_telemetry_overhead": rt}
+                "smoke_prefix_cache": rpx, "smoke_telemetry_overhead": rt,
+                "smoke_recorder_overhead": rrec}
         print(json.dumps({"metric": "smoke", "value": r["tokens_per_s"],
                           "unit": "tokens/s", "vs_baseline": 1.0,
                           "configs": cfgs}))
@@ -1361,6 +1426,14 @@ def main():
             results["flagship_1b_b16"]["step_ms"])
     except Exception as exc:
         results["telemetry_overhead"] = {"error": str(exc)[:200]}
+
+    # Flight-recorder acceptance: event emission <1% of a fused decode
+    # step, disabled and enabled (3-emit incident-path bound).
+    try:
+        results["recorder_overhead"] = bench_recorder_overhead(
+            results["flagship_1b_b16"]["step_ms"])
+    except Exception as exc:
+        results["recorder_overhead"] = {"error": str(exc)[:200]}
 
     primary = results["flagship_1b_b16"]
 

@@ -49,6 +49,14 @@ from .scheduling.registry import PlacementRegistry
 
 logger = logging.getLogger("mini_petals_tpu")
 
+
+def _emit(*parts, **kwargs) -> None:
+    """CLI output boundary: every user-facing stdout line in this module
+    goes through here (scripts/check_no_bare_print.py enforces it).
+    Diagnostics belong on a logger; _emit is for the REPORT a mode exists
+    to print — generation text, status tables, scrape output."""
+    print(*parts, **kwargs)  # noqa: T201 — the one sanctioned print
+
 # float16 runs as bfloat16: TPUs have no fp16 compute path (load_model warns).
 _DTYPE_MAP = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
               "float16": jnp.bfloat16}
@@ -714,15 +722,15 @@ def _run_fused_ring(args, cfg: ModelConfig, params, num_stages: int) -> int:
 
     for g, toks_g in enumerate(sessions):
         text = tokenizer.decode(toks_g[:args.max_new_tokens])
-        print(f"\n=== Session {g} ({len(toks_g[:args.max_new_tokens])} "
+        _emit(f"\n=== Session {g} ({len(toks_g[:args.max_new_tokens])} "
               f"tokens) ===\n{text}")
-    print(f"\nTTFT (all {G} prefills): {ttft:.3f}s")
+    _emit(f"\nTTFT (all {G} prefills): {ttft:.3f}s")
     rate = produced / decode_s if decode_s > 0 else 0.0
-    print(f"Decode: {decode_s:.3f}s total, {rate:.2f} tokens/s aggregate "
+    _emit(f"Decode: {decode_s:.3f}s total, {rate:.2f} tokens/s aggregate "
           f"across {G} sessions (decode-loop tokens only; each session's "
           f"first token comes from prefill)")
     if spec_k and rounds:
-        print(f"Speculative: {rounds} rounds, "
+        _emit(f"Speculative: {rounds} rounds, "
               f"{accepted / (rounds * len(sessions)):.2f} drafts accepted "
               f"per session-round (of {spec_k})")
     return 0
@@ -755,12 +763,12 @@ def _generate_and_report(args, generate_fn, cfg: ModelConfig,
     text = tokenizer.decode(res.tokens)
     # The reference's closing report (src/main.py:213-225): TTFT, decode
     # time, tokens/s.
-    print(f"\n=== Generation ({len(res.tokens)} tokens, "
+    _emit(f"\n=== Generation ({len(res.tokens)} tokens, "
           f"stopped by {res.stopped_by}) ===")
-    print(text)
-    print(f"\nTTFT: {res.ttft_s:.3f}s")
+    _emit(text)
+    _emit(f"\nTTFT: {res.ttft_s:.3f}s")
     total_decode = sum(res.decode_times_s)
-    print(f"Decode: {total_decode:.3f}s total, "
+    _emit(f"Decode: {total_decode:.3f}s total, "
           f"{res.decode_tokens_per_s:.2f} tokens/s")
     return 0
 
@@ -804,7 +812,7 @@ def run_registry(args, cfg: ModelConfig, params) -> int:
     srv.start()
     # Machine-readable handshake line (the reference printed the DHT maddr
     # for run_all.py to scrape, src/main.py:449-465).
-    print(f"REGISTRY_ADDR={srv.address}", flush=True)
+    _emit(f"REGISTRY_ADDR={srv.address}", flush=True)
     try:
         while True:
             time.sleep(3600)
@@ -943,7 +951,7 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
     rec.max_context = getattr(ex, "max_context", None)
     rec.address = advert
     registry.register(rec)
-    print(f"SERVING stage={args.stage} span=[{spec.start},{spec.end}) "
+    _emit(f"SERVING stage={args.stage} span=[{spec.start},{spec.end}) "
           f"addr={advert} peer={ex.peer_id}", flush=True)
     # Next-hop RTT probe (petals/server/server.py:760-767): a TcpTransport
     # resolves peers via the registry, so pings hit the real data-plane wire.
@@ -1059,7 +1067,7 @@ def _run_serve_elastic(args, cfg: ModelConfig, params) -> int:
         model=_model_id(args),
     )
     es.start()
-    print(f"SERVING elastic span=[{es.spec.start},{es.spec.end}) "
+    _emit(f"SERVING elastic span=[{es.spec.start},{es.spec.end}) "
           f"addr={advert} peer={peer}", flush=True)
     try:
         while True:
@@ -1114,15 +1122,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mode",
                    choices=["local", "fused", "oracle",
                             "registry", "serve", "client", "status",
-                            "metrics", "dcn-check"],
+                            "metrics", "doctor", "dcn-check"],
                    default="local")
     p.add_argument("--telemetry", action="store_true",
-                   help="enable the process-global metrics registry and "
-                        "request tracer (telemetry package). Servers then "
-                        "answer the 'metrics' verb with a Prometheus text "
-                        "exposition; clients fold their series into the "
-                        "same registry. Default off: every instrument site "
-                        "is a cheap boolean check.")
+                   help="enable the process-global metrics registry, "
+                        "request tracer, and flight recorder (telemetry "
+                        "package). Servers then answer the 'metrics' and "
+                        "'dump-events' verbs; clients fold their series "
+                        "into the same registry. Default off: every "
+                        "instrument site is a cheap boolean check.")
+    p.add_argument("--events-dump", dest="events_dump", default=None,
+                   metavar="PATH",
+                   help="enable the flight recorder and write its event "
+                        "ring to PATH as JSONL on fatal exceptions, "
+                        "SIGTERM/SIGINT, and normal exit — the file "
+                        "--mode doctor ingests. Implies the recorder even "
+                        "without --telemetry.")
+    p.add_argument("--dumps", default=None, metavar="PATHS",
+                   help="doctor mode: comma-separated event-dump files "
+                        "(--events-dump output) to diagnose; omit to "
+                        "scrape LIVE servers' event rings via the "
+                        "registry instead")
+    p.add_argument("--log-json", dest="log_json", action="store_true",
+                   help="emit every log record as one JSON object per "
+                        "line (machine-ingestable) instead of the "
+                        "structured text format")
     p.add_argument("--model", default="gpt2",
                    help="architecture preset (gpt2[-xl], llama-3-8b, ...)")
     p.add_argument("--model_name", default=None,
@@ -1290,12 +1314,12 @@ def _print_swarm_health(infos: dict, total_servers: int = 0) -> None:
     if not infos:
         return
     unreachable = max(0, total_servers - len(infos))
-    print(f"swarm health ({len(infos)}/{total_servers or len(infos)} "
+    _emit(f"swarm health ({len(infos)}/{total_servers or len(infos)} "
           "server rings probed):")
     if unreachable:
         # An unreachable server is the LIKELIEST one erroring — never let
         # a clean aggregate of the reachable rings read as all-clear.
-        print(f"  WARNING: {unreachable} server(s) unreachable for info — "
+        _emit(f"  WARNING: {unreachable} server(s) unreachable for info — "
               "their rings are NOT included below")
     errs = []     # (count, peer, last error record)
     slows = []    # (max_dur_ms, peer, verb)
@@ -1312,13 +1336,13 @@ def _print_swarm_health(infos: dict, total_servers: int = 0) -> None:
     if errs:
         errs.sort(reverse=True)
         for n, peer, last in errs[:3]:
-            print(f"  errors: {peer} x{n} (last: {last.get('verb')} "
+            _emit(f"  errors: {peer} x{n} (last: {last.get('verb')} "
                   f"{last.get('outcome')} {last.get('detail', '')})")
     else:
-        print(f"  errors: none in the {len(infos)} probed ring(s)")
+        _emit(f"  errors: none in the {len(infos)} probed ring(s)")
     if slows:
         slows.sort(reverse=True)
-        print("  slowest hops: " + ", ".join(
+        _emit("  slowest hops: " + ", ".join(
             f"{peer} {d:.1f}ms ({v})" for d, peer, v in slows[:3]))
     pfx = [(peer, inf["prefix_cache"]) for peer, inf in infos.items()
            if isinstance(inf.get("prefix_cache"), dict)]
@@ -1327,7 +1351,7 @@ def _print_swarm_health(infos: dict, total_servers: int = 0) -> None:
         misses = sum(s.get("misses", 0) for _, s in pfx)
         total = hits + misses
         rate = f"{hits / total:.0%}" if total else "n/a"
-        print(f"  prefix cache: {len(pfx)} server(s), hit rate {rate} "
+        _emit(f"  prefix cache: {len(pfx)} server(s), hit rate {rate} "
               f"({hits}/{total}), "
               f"{sum(s.get('grains_reused', 0) for _, s in pfx)} grains "
               f"reused, "
@@ -1337,7 +1361,7 @@ def _print_swarm_health(infos: dict, total_servers: int = 0) -> None:
                 if inf.get("cache_tokens_left") is not None]
     if pressure:
         lo, lo_peer = min(pressure)
-        print(f"  cache pressure: min {lo} tokens left ({lo_peer}); "
+        _emit(f"  cache pressure: min {lo} tokens left ({lo_peer}); "
               f"total {sum(p for p, _ in pressure)} across "
               f"{len(pressure)} server(s)")
 
@@ -1370,13 +1394,13 @@ def run_metrics(args) -> int:
     registry = RemoteRegistry(args.registry_addr)
     records = registry.live_servers(model=args.model_name)
     if not records:
-        print("no live servers")
+        _emit("no live servers")
         return 1
     snap = _PR()
     for r in records:
         snap.register(r)
     tx = TcpTransport(snap, wire_dtype=args.wire_dtype)
-    scraped = 0
+    scraped, failed = 0, []
     try:
         for r in sorted(records, key=lambda r: (r.start_block, r.peer_id)):
             if not r.address:
@@ -1384,19 +1408,29 @@ def run_metrics(args) -> int:
             try:
                 text = tx.metrics_text(r.peer_id, timeout=3.0)
             except Exception as exc:
-                print(f"# peer {r.peer_id}: scrape failed "
+                _emit(f"# peer {r.peer_id}: scrape failed "
                       f"({type(exc).__name__})")
+                failed.append((r.peer_id, r.address,
+                               f"{type(exc).__name__}: {exc}"))
                 continue
-            print(f"# ==== peer {r.peer_id} [{r.start_block},"
+            _emit(f"# ==== peer {r.peer_id} [{r.start_block},"
                   f"{r.end_block}) ====")
             if text.strip():
-                print(text, end="" if text.endswith("\n") else "\n")
+                _emit(text, end="" if text.endswith("\n") else "\n")
             else:
-                print("# (telemetry disabled on this peer — "
+                _emit("# (telemetry disabled on this peer — "
                       "start it with --telemetry)")
             scraped += 1
     finally:
         tx.close()
+    if failed:
+        # A registered-but-unreachable server is an operational problem the
+        # scrape must not paper over: name each one and exit non-zero so
+        # cron/CI notices even when other peers answered.
+        for peer, addr, err in failed:
+            _emit(f"error: server {peer} at {addr} unreachable: {err}",
+                  file=sys.stderr)
+        return 1
     return 0 if scraped else 1
 
 
@@ -1415,19 +1449,20 @@ def run_status(args) -> int:
     # the report (and its health verdict) to that model's records.
     records = registry.live_servers(model=args.model_name)
     if not records:
-        print("no live servers")
+        _emit("no live servers")
         return 1
     total = args.total_blocks or max(r.end_block for r in records)
     if not args.total_blocks:
-        print("warning: total_blocks inferred from LIVE records — dead "
+        _emit("warning: total_blocks inferred from LIVE records — dead "
               "tail-stage servers shrink it; pass --total_blocks for a "
               "reliable health check")
-    print(f"{len(records)} live server(s); total_blocks={total}")
+    _emit(f"{len(records)} live server(s); total_blocks={total}")
     snap = _PR()
     for r in records:
         snap.register(r)
     tx = TcpTransport(snap, wire_dtype=args.wire_dtype)
     infos = {}
+    unreachable = []
     for r in sorted(records, key=lambda r: (r.start_block, r.peer_id)):
         extra = ""
         if r.address:
@@ -1439,6 +1474,8 @@ def run_status(args) -> int:
                 extra += _status_telemetry_line(inf.get("telemetry"))
             except Exception as exc:
                 extra = f" info_probe_failed({type(exc).__name__})"
+                unreachable.append(
+                    (r.peer_id, r.address, f"{type(exc).__name__}: {exc}"))
         rtts = ("" if not r.next_server_rtts else
                 " rtts=" + ",".join(f"{p}:{v * 1e3:.1f}ms"
                                     for p, v in r.next_server_rtts.items()))
@@ -1447,7 +1484,7 @@ def run_status(args) -> int:
         # operator needs to know when a request class is being refused.
         eng = (f" eng={r.engine}" if getattr(r, "engine", None)
                and r.engine != "session" else "")
-        print(f"  {r.peer_id:24s} [{r.start_block:3d},{r.end_block:3d}) "
+        _emit(f"  {r.peer_id:24s} [{r.start_block:3d},{r.end_block:3d}) "
               f"{r.state:8s} thr={r.throughput:8.2f} "
               f"cache_left={r.cache_tokens_left}"
               f"{' FINAL' if r.final_stage else ''}{eng}{mdl}{rtts}{extra}")
@@ -1466,7 +1503,7 @@ def run_status(args) -> int:
             runs.append((start, b, cov[start]))
             start = b
     prefix = f"[0,{base}) client-local; " if base else ""
-    print("coverage: " + prefix + ", ".join(
+    _emit("coverage: " + prefix + ", ".join(
         f"[{a},{b})x{n}" + ("  <-- UNCOVERED" if n == 0 else "")
         for a, b, n in runs))
     _print_swarm_health(infos, total_servers=len(records))
@@ -1475,9 +1512,62 @@ def run_status(args) -> int:
     if not any(r.final_stage for r in records):
         # Catches the dead-tail case even when total_blocks was inferred:
         # a swarm with no live final stage cannot finish any request.
-        print("no live FINAL-stage server  <-- UNHEALTHY")
+        _emit("no live FINAL-stage server  <-- UNHEALTHY")
+        healthy = False
+    if unreachable:
+        # A registered server that won't answer its own info verb is not a
+        # healthy swarm, whatever the coverage map says.
+        for peer, addr, err in unreachable:
+            _emit(f"error: server {peer} at {addr} unreachable: {err}",
+                  file=sys.stderr)
         healthy = False
     return 0 if healthy else 2
+
+
+def run_doctor(args) -> int:
+    """Post-mortem / live diagnosis: merge per-process flight-recorder
+    streams onto one timeline and report failure chains (timeout →
+    failover → replay → rebalance), per-session replay cost, and metric
+    anomalies. Sources: ``--dumps f1.jsonl,f2.jsonl`` (files written by
+    ``--events-dump`` / crash hooks), else a LIVE scrape of every
+    registered server's event ring over the ``dump-events`` verb."""
+    from .telemetry import doctor as _doc
+
+    if args.dumps:
+        paths = [p.strip() for p in args.dumps.split(",") if p.strip()]
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            _emit("error: dump file(s) not found: " + ", ".join(missing),
+                  file=sys.stderr)
+            return 1
+        _emit(_doc.diagnose(paths), end="")
+        return 0
+
+    from .runtime.net import RemoteRegistry, TcpTransport
+    from .scheduling.registry import PlacementRegistry as _PR
+
+    registry = RemoteRegistry(args.registry_addr)
+    records = registry.live_servers(model=args.model_name)
+    if not records:
+        _emit("no live servers and no --dumps given")
+        return 1
+    snap = _PR()
+    for r in records:
+        snap.register(r)
+    tx = TcpTransport(snap, wire_dtype=args.wire_dtype)
+    try:
+        streams = _doc.scrape_events(
+            tx, [r.peer_id for r in sorted(
+                records, key=lambda r: (r.start_block, r.peer_id))
+                if r.address])
+    finally:
+        tx.close()
+    if not streams:
+        _emit("no event streams scraped (are servers running with "
+              "--telemetry or --events-dump?)")
+        return 1
+    _emit(_doc.diagnose_streams(streams), end="")
+    return 0
 
 
 def run_dcn_check(args) -> int:
@@ -1498,7 +1588,7 @@ def run_dcn_check(args) -> int:
     got, want = dcn.sanity_check()
     ring_ok = dcn.ring_shift()
     ok = (got == want) and ring_ok
-    print(f"DCN_CHECK process={_jax.process_index()}/{_jax.process_count()} "
+    _emit(f"DCN_CHECK process={_jax.process_index()}/{_jax.process_count()} "
           f"devices={_jax.local_device_count()}/{_jax.device_count()} "
           f"psum={got}/{want} ring={'ok' if ring_ok else 'FAIL'} "
           f"{'OK' if ok else 'FAIL'}", flush=True)
@@ -1508,17 +1598,39 @@ def run_dcn_check(args) -> int:
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    from .telemetry import setup_logging
+
+    setup_logging(json_mode=args.log_json,
+                  level=logging.DEBUG if args.verbose else logging.INFO)
     if args.telemetry:
-        # Flip the process-global registry + tracer BEFORE any component
-        # fetches metric handles; register_all() inside makes even
-        # zero-valued families visible to the first scrape.
+        # Flip the process-global registry + tracer + flight recorder
+        # BEFORE any component fetches metric handles; register_all()
+        # inside makes even zero-valued families visible to the first
+        # scrape.
         from . import telemetry
 
         telemetry.enable()
+    if args.events_dump:
+        # --events-dump alone still records: flip just the recorder (the
+        # metrics registry stays off unless --telemetry asked for it) and
+        # arm the crash hooks so a fatal exception or SIGTERM/SIGINT
+        # leaves the dump behind for --mode doctor.
+        import atexit
+
+        from .telemetry import events as _events
+
+        _events.get_recorder().enable()
+        _events.emit("process_start", mode=args.mode, pid=os.getpid())
+        reg = None
+        if args.telemetry:
+            from . import telemetry as _t
+
+            reg = _t.get_registry()
+        _events.install_crash_hooks(args.events_dump, registry=reg)
+        # Normal exits dump too — doctor runs are not crash-only.
+        atexit.register(
+            lambda: _events.get_recorder().dump(args.events_dump,
+                                                registry=reg))
     if args.mode == "registry":
         return run_registry(args, None, None)  # no model needed
     if args.mode == "dcn-check":
@@ -1527,6 +1639,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_status(args)  # no model needed
     if args.mode == "metrics":
         return run_metrics(args)  # no model needed
+    if args.mode == "doctor":
+        return run_doctor(args)  # no model needed
     cfg, params = load_model(args)
     run = {"local": run_local, "fused": run_fused, "oracle": run_oracle,
            "serve": run_serve, "client": run_client}[args.mode]

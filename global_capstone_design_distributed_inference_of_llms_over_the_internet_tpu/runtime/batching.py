@@ -52,6 +52,7 @@ from ..models.transformer import (
 from ..ops.rotary import apply_rope
 from ..parallel.ring_attention import NEG_INF
 from ..telemetry import catalog as _tm
+from ..telemetry import events as _ev
 from .kv_cache import round_to_bucket
 
 Params = Dict[str, Any]
@@ -765,6 +766,8 @@ class BatchingStageAdapter:
         if (req.train or req.hypo_ids is not None or req.num_logprobs
                 or req.is_replay or req.prompts is not None
                 or req.start_from_position not in (None, req.cur_len)):
+            _ev.emit("task_rejected", session_id=req.session_id,
+                     pool="batched", reason="unsupported request kind")
             raise StageExecutionError(
                 "batched peer serves plain prefill/decode and speculative "
                 "verify only (route beam/training/replay/deep-prompt "
@@ -772,6 +775,8 @@ class BatchingStageAdapter:
         if req.start_block is not None and (
                 req.start_block != self.spec.start
                 or (req.end_block or self.spec.end) != self.spec.end):
+            _ev.emit("task_rejected", session_id=req.session_id,
+                     pool="batched", reason="sub-span request")
             raise StageExecutionError(
                 "batched peer serves its full span only")
         if req.is_prefill:

@@ -40,6 +40,7 @@ from ..ops.sampling import SamplingParams
 from ..scheduling.registry import PlacementRegistry, ServerRecord
 from ..telemetry import MetricsRegistry, get_tracer
 from ..telemetry import catalog as _tm
+from ..telemetry import events as _ev
 from .executor import StageExecutionError, StageExecutor
 from .messages import StageRequest, StageResponse, clip_generated
 from .transport import PeerUnavailable, Transport
@@ -515,6 +516,10 @@ class PipelineClient:
         (``src/rpc_transport.py:670-712``): first chunk as prefill, the rest
         as is_replay decode chunks with cumulative cur_len."""
         entries = self.journal.get(hop.key, {}).get(session_id, [])
+        tokens = sum(e.seq_len for e in entries)
+        _ev.emit("replay_start", session_id=session_id, peer=hop.peer_id,
+                 entries=len(entries), tokens=tokens)
+        t0 = time.monotonic()
         for i, e in enumerate(entries):
             req = StageRequest(
                 session_id=session_id,
@@ -531,6 +536,8 @@ class PipelineClient:
                 prompts=self._hop_prompts(session_id, hop, e.cur_len),
             )
             self.transport.call(hop.peer_id, req, timeout=self.request_timeout)
+        _ev.emit("replay_done", session_id=session_id, peer=hop.peer_id,
+                 tokens=tokens, seconds=round(time.monotonic() - t0, 4))
 
     def _hop_prompts(self, session_id: str, hop: Hop, cur_len: int = 0):
         return self._span_prompts(session_id, hop.start_block,
@@ -569,18 +576,31 @@ class PipelineClient:
                     StageExecutionError) as exc:
                 last_exc = exc
                 self._m_retries.inc()
+                trace_id = (req.trace or {}).get("trace_id") \
+                    if isinstance(req.trace, dict) else None
+                _ev.emit("hop_retry", session_id=req.session_id,
+                         trace_id=trace_id, hop=hop.key, peer=hop.peer_id,
+                         attempt=attempt + 1,
+                         error=f"{type(exc).__name__}: {exc}"[:200])
+                _ev.emit("peer_failed", session_id=req.session_id,
+                         trace_id=trace_id, hop=hop.key, peer=hop.peer_id,
+                         reason=type(exc).__name__)
                 failed = self.failed_peers.setdefault(hop.key, set())
                 failed.add(hop.peer_id)
                 logger.warning(
                     "hop %s peer %s failed (attempt %d/%d): %s",
                     hop.key, hop.peer_id, attempt + 1, MAX_ATTEMPTS, exc,
                 )
+                old_peer = hop.peer_id
                 try:
                     replacement = self._rediscover(hop)
                 except NoRouteError:
                     continue  # maybe a peer re-registers before we run out
                 hop.peer_id = replacement
                 self._m_recoveries.inc()
+                _ev.emit("failover", session_id=req.session_id,
+                         trace_id=trace_id, hop=hop.key, old_peer=old_peer,
+                         new_peer=replacement)
                 try:
                     self._replay(hop, req.session_id, req.sampling, req.max_length)
                 except Exception as replay_exc:  # replacement died too
@@ -602,6 +622,8 @@ class PipelineClient:
             # (the reference never un-marks a failed peer and can wedge a
             # long-lived client); give recently-failed peers another chance
             # rather than hard-failing with live servers present.
+            _ev.emit("blacklist_amnesty", hop=hop.key,
+                     cleared=len(self.failed_peers.get(hop.key, ())))
             self.failed_peers.get(hop.key, set()).clear()
             peer = self._rediscover_excluding(hop, ())
         if peer is None:
@@ -795,6 +817,10 @@ class PipelineClient:
     def _replay_chain(self, hops: List[Hop], session_id: str,
                       sampling: SamplingParams, max_length: int) -> None:
         entries = self.journal.get(self.CHAIN_KEY, {}).get(session_id, [])
+        tokens = sum(e.seq_len for e in entries)
+        _ev.emit("replay_start", session_id=session_id,
+                 peer=hops[0].peer_id, entries=len(entries), tokens=tokens)
+        t0 = time.monotonic()
         for i, e in enumerate(entries):
             req = self._chain_request(
                 hops, jnp.asarray(e.hidden), e.seq_len, e.cur_len, session_id,
@@ -803,6 +829,9 @@ class PipelineClient:
             )
             self.transport.call(hops[0].peer_id, req,
                                 timeout=self.request_timeout)
+        _ev.emit("replay_done", session_id=session_id,
+                 peer=hops[0].peer_id, tokens=tokens,
+                 seconds=round(time.monotonic() - t0, 4))
 
     def _blame_chain_failure(self, hops: List[Hop], exc: Exception) -> None:
         """Blacklist the hop responsible for a chain failure and invalidate
@@ -819,6 +848,8 @@ class PipelineClient:
         blame = blame or hops[0].peer_id
         blamed_hop = next((h for h in hops if h.peer_id == blame), hops[0])
         self.failed_peers.setdefault(blamed_hop.key, set()).add(blame)
+        _ev.emit("peer_failed", hop=blamed_hop.key, peer=blame,
+                 reason=type(exc).__name__)
         self._routes.clear()  # recompute with the blacklist applied
         logger.warning("push chain failed at %s: %s", blame, exc)
 
@@ -875,6 +906,10 @@ class PipelineClient:
                 # not wedge the client forever (same amnesty as the per-hop
                 # path's _rediscover, client.py _rediscover).
                 blacklist_cleared = True
+                _ev.emit("blacklist_amnesty", session_id=session_id,
+                         hop=self.CHAIN_KEY,
+                         cleared=sum(len(v)
+                                     for v in self.failed_peers.values()))
                 self.failed_peers.clear()
                 self._routes.clear()
                 continue
@@ -903,6 +938,11 @@ class PipelineClient:
                 chain_span.end(error=repr(exc))
                 last_exc = exc
                 self._m_retries.inc()
+                _ev.emit("hop_retry", session_id=session_id,
+                         trace_id=root.trace_id if root else None,
+                         hop=self.CHAIN_KEY, peer=hops[0].peer_id,
+                         attempt=attempt + 1,
+                         error=f"{type(exc).__name__}: {exc}"[:200])
                 self._blame_chain_failure(hops, exc)
                 try:
                     new_hops = self.route(kind="exotic")
@@ -920,6 +960,10 @@ class PipelineClient:
                     self._blame_chain_failure(new_hops, rexc)
                     continue
                 self._m_recoveries.inc()
+                _ev.emit("failover", session_id=session_id,
+                         trace_id=root.trace_id if root else None,
+                         hop=self.CHAIN_KEY, old_peer=hops[0].peer_id,
+                         new_peer=new_hops[0].peer_id)
                 if self.settle_seconds:
                     time.sleep(self.settle_seconds)
                 continue
@@ -981,16 +1025,25 @@ class PipelineClient:
         session_id = session_id or f"sess-{time.monotonic_ns():x}"
         if deep_prompts is not None:
             self._session_prompts[session_id] = np.asarray(deep_prompts)
+        _ev.emit("session_start", session_id=session_id,
+                 prompt_len=len(prompt_ids), max_new_tokens=max_new_tokens)
+        recoveries_before = self.recoveries
+        result = None
         try:
-            return self._generate_impl(
+            result = self._generate_impl(
                 prompt_ids, max_new_tokens, sampling=sampling,
                 eos_token_id=eos_token_id, session_id=session_id,
                 max_length=max_length, speculative_k=speculative_k,
                 draft_fn=draft_fn)
+            return result
         finally:
             # Error paths included: a failed session must not leak its
             # deep-prompt tensor, KV leases, or journal entries.
             self._end_session(session_id)
+            _ev.emit("session_end", session_id=session_id,
+                     tokens=(len(result.tokens)
+                             if result is not None else None),
+                     recoveries=self.recoveries - recoveries_before)
 
     def _generate_impl(
         self,

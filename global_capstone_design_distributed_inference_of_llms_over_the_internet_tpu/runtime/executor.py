@@ -45,6 +45,7 @@ from ..models.partition import (
 )
 from ..ops.sampling import RECENT_WINDOW, sample_token
 from ..models.transformer import stack_forward_train
+from ..telemetry import events as _ev
 from ..utils.platform import engine_donation
 from .kv_cache import AllocationFailed, KVArena, KVHandle, round_to_bucket
 from .messages import (
@@ -411,10 +412,14 @@ class StageExecutor:
         taxonomy, so the session fails over to a replica with free memory
         instead of crashing the generation."""
         try:
-            return self.arena.allocate(req.session_id, req.max_length,
-                                       num_layers=num_layers, batch=batch)
+            handle = self.arena.allocate(req.session_id, req.max_length,
+                                         num_layers=num_layers, batch=batch)
         except AllocationFailed as exc:
             raise StageExecutionError(str(exc)) from exc
+        _ev.emit("server_session_open", session_id=req.session_id,
+                 peer=self.peer_id, max_length=req.max_length,
+                 replay=req.is_replay)
+        return handle
 
     def _session_cache(self, req: StageRequest, num_layers: int,
                        batch: int = 1) -> KVHandle:
@@ -913,6 +918,9 @@ class StageExecutor:
         self.drop_session("__warmup__")
 
     def drop_session(self, session_id: str) -> None:
+        if self.arena.get(session_id) is not None:
+            _ev.emit("server_session_closed", session_id=session_id,
+                     peer=self.peer_id)
         self.arena.free(session_id)
 
     def session_len(self, session_id: str) -> Optional[int]:

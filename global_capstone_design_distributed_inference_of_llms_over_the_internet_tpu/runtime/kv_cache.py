@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from ..telemetry import catalog as _tm
+from ..telemetry import events as _ev
 
 
 class AllocationFailed(RuntimeError):
@@ -217,11 +218,15 @@ class KVArena:
                 )
         except AllocationFailed:
             self._m_alloc_failures.inc()
+            _ev.emit("kv_alloc_failed", session_id=session_id,
+                     reason="oversized")
             raise
         deadline = time.monotonic() + timeout
         with self._lock:
             if session_id in self._handles or session_id in self._pending:
                 self._m_alloc_failures.inc()
+                _ev.emit("kv_alloc_failed", session_id=session_id,
+                         reason="duplicate_session")
                 raise AllocationFailed(f"session {session_id} already allocated")
             self._pending.add(session_id)
             self._enqueued_bytes += nbytes
@@ -230,6 +235,8 @@ class KVArena:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0 or not self._lock.wait(remaining):
                         self._m_alloc_failures.inc()
+                        _ev.emit("kv_alloc_failed", session_id=session_id,
+                                 reason="arena_full_timeout")
                         raise AllocationFailed(
                             f"arena full: {self._used_bytes}/{self.max_bytes} "
                             f"bytes used, need {nbytes}, timed out after "
@@ -241,7 +248,11 @@ class KVArena:
                 raise
             finally:
                 self._enqueued_bytes -= nbytes
-            self._m_alloc_wait.observe(time.monotonic() - t_alloc)
+            wait_s = time.monotonic() - t_alloc
+            self._m_alloc_wait.observe(wait_s)
+            if wait_s > 0.01:   # only real backpressure, not lock latency
+                _ev.emit("kv_backpressure", session_id=session_id,
+                         wait_s=round(wait_s, 4))
             self._m_allocs.inc()
             self._publish_occupancy()
 
@@ -346,13 +357,15 @@ class KVArena:
         now = time.monotonic()
         with self._lock:
             stale = [
-                sid for sid, h in self._handles.items()
+                (sid, h.nbytes) for sid, h in self._handles.items()
                 if now - h.last_used > older_than
             ]
-        for sid in stale:
+        for sid, _ in stale:
             self.free(sid)
         if stale:
             self._m_evictions.inc(len(stale))
+            _ev.emit("kv_eviction", sessions=len(stale),
+                     bytes=sum(b for _, b in stale))
         return len(stale)
 
     def active_sessions(self) -> Tuple[str, ...]:

@@ -48,6 +48,7 @@ from .. import native
 from ..ops.sampling import SamplingParams
 from ..scheduling.registry import PlacementRegistry, ServerRecord
 from ..telemetry import catalog as _tm
+from ..telemetry import events as _ev
 from ..telemetry import exposition as _texp
 from ..telemetry import get_registry as _get_metrics_registry
 from ..telemetry import get_tracer
@@ -327,6 +328,17 @@ def _header_to_request(h: dict, payload: bytes) -> StageRequest:
         prefix_len=h.get("prefix_len", 0),
         trace=h.get("trace"),
     )
+
+
+def _trace_id(req: StageRequest) -> Optional[str]:
+    """Trace id riding the request's wire trace context, if any — lets
+    flight-recorder events on both sides of a hop correlate with the
+    client's distributed trace."""
+    trace = getattr(req, "trace", None)
+    if isinstance(trace, dict):
+        tid = trace.get("trace_id")
+        return str(tid) if tid is not None else None
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -647,6 +659,17 @@ class TcpStageServer(_FramedTcpServer):
                 "text": _texp.render(_get_metrics_registry()),
             })
             return
+        if verb == "dump-events":
+            # Flight-recorder scrape: this PROCESS's event ring as JSONL,
+            # with the metrics snapshot embedded, exactly what a crash dump
+            # would have written. Executor-less for the same reason as
+            # `metrics`; empty event stream when the recorder is disabled.
+            _send_frame(sock, {
+                "verb": "events",
+                "lines": _ev.get_recorder().render_jsonl(
+                    registry=_get_metrics_registry()),
+            })
+            return
         # Snapshot: the elastic rebalance thread may null/swap self.executor
         # at any moment; every later access in this request must see ONE
         # consistent executor (a mid-request swap would otherwise surface as
@@ -876,6 +899,9 @@ class TcpStageServer(_FramedTcpServer):
         except (StageExecutionError, TaskRejected) as exc:
             _log("stage_error", str(exc))
             m_requests.labels(outcome="error").inc()
+            _ev.emit("stage_error", session_id=req.session_id,
+                     trace_id=_trace_id(req), peer=ex.peer_id,
+                     phase=phase, error=str(exc)[:200])
             span.end(error=repr(exc))
             _send_frame(sock, {"verb": "error", "message": str(exc),
                                "kind": "stage",
@@ -886,6 +912,9 @@ class TcpStageServer(_FramedTcpServer):
                       else self.compute_timeout)
             _log("timeout")
             m_requests.labels(outcome="timeout").inc()
+            _ev.emit("stage_timeout", session_id=req.session_id,
+                     trace_id=_trace_id(req), peer=ex.peer_id,
+                     phase=phase, budget_s=budget)
             span.end(error="timeout")
             _send_frame(sock, {"verb": "error", "kind": "stage",
                                "peer": ex.peer_id,
@@ -1271,9 +1300,14 @@ class TcpTransport(Transport):
             self._m_recv.inc(len(payload))
         except socket.timeout as exc:
             self._drop(peer_id)
+            _ev.emit("transport_timeout", session_id=request.session_id,
+                     trace_id=_trace_id(request), peer=peer_id)
             raise TimeoutError(f"peer {peer_id} timed out") from exc
         except (ConnectionError, OSError) as exc:
             self._drop(peer_id)
+            _ev.emit("transport_error", session_id=request.session_id,
+                     trace_id=_trace_id(request), peer=peer_id,
+                     error=str(exc)[:200])
             raise PeerUnavailable(f"peer {peer_id} connection failed: {exc}")
         return self._parse_response(peer_id, header, payload)
 
@@ -1360,9 +1394,14 @@ class TcpTransport(Transport):
             self._m_recv.inc(len(payload))
         except socket.timeout as exc:
             self._drop(peer_id)
+            _ev.emit("transport_timeout", session_id=request.session_id,
+                     trace_id=_trace_id(request), peer=peer_id)
             raise TimeoutError(f"peer {peer_id} timed out") from exc
         except (ConnectionError, OSError) as exc:
             self._drop(peer_id)
+            _ev.emit("transport_error", session_id=request.session_id,
+                     trace_id=_trace_id(request), peer=peer_id,
+                     error=str(exc)[:200])
             raise PeerUnavailable(f"peer {peer_id} connection failed: {exc}")
         try:
             resp = self._parse_response(peer_id, header, payload)
@@ -1533,6 +1572,23 @@ class TcpTransport(Transport):
             raise WireError(
                 f"unexpected response verb {header.get('verb')!r}")
         return header.get("text", "")
+
+    def events_text(self, peer_id: str, timeout: float = 5.0) -> str:
+        """Flight-recorder scrape of a peer's event ring as JSONL (the
+        ``dump-events`` verb) — what ``--mode doctor`` ingests from LIVE
+        servers. Meta line only when the peer's recorder is disabled."""
+        sock = self._connect(peer_id)
+        try:
+            sock.settimeout(timeout)
+            _send_frame(sock, {"verb": "dump-events"})
+            header, _ = _recv_frame(sock)
+        except (ConnectionError, OSError) as exc:
+            self._drop(peer_id)
+            raise PeerUnavailable(f"peer {peer_id}: {exc}")
+        if header.get("verb") != "events":
+            raise WireError(
+                f"unexpected response verb {header.get('verb')!r}")
+        return header.get("lines", "")
 
     def reach_check(self, peer_id: str, target: str,
                     timeout: float = 8.0) -> bool:
@@ -1827,6 +1883,8 @@ class RemoteRegistry:
             # survive an outage shorter than the TTL.
             if self._stale_since is None:
                 self._stale_since = time.monotonic()
+                _ev.emit("registry_unreachable",
+                         registries=len(self._addrs))
                 logger.warning(
                     "all %d registr%s unreachable; serving the cached "
                     "record snapshot under TTL grace",

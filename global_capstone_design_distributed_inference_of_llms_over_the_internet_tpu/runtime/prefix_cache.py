@@ -61,6 +61,7 @@ from typing import List, Optional
 import jax.numpy as jnp
 
 from ..telemetry import catalog as _tm
+from ..telemetry import events as _ev
 
 # Tokens per cached segment. Smaller = finer shared-prefix matching but
 # more entries and more copy calls per hit; 64 keeps a segment's KV write
@@ -154,6 +155,7 @@ class PrefixStore:
         if nbytes > self.max_bytes:
             return False
         entry = PrefixEntry(k=k, v=v, out=out, nbytes=nbytes)
+        evicted, evicted_bytes = 0, 0
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
@@ -163,9 +165,13 @@ class PrefixStore:
                 self.used_bytes -= victim.nbytes
                 self.evictions += 1
                 self._m_evictions.inc()
+                evicted += 1
+                evicted_bytes += victim.nbytes
             self._entries[key] = entry
             self.used_bytes += nbytes
             self._m_bytes.set(self.used_bytes)
+        if evicted:
+            _ev.emit("prefix_eviction", grains=evicted, bytes=evicted_bytes)
         return True
 
     def stats(self) -> dict:

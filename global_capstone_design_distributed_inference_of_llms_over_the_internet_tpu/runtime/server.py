@@ -41,6 +41,7 @@ from ..scheduling.registry import (
 )
 from ..scheduling.throughput import get_server_throughput
 from ..telemetry import catalog as _tm
+from ..telemetry import events as _ev
 from .executor import StageExecutor
 from .transport import LocalTransport, Transport
 
@@ -301,6 +302,8 @@ class ElasticStageServer:
         if self.probe_throughput:
             self.throughput = self._probe()
         self.registry.register(self._record())
+        _ev.emit("server_join", peer=self.peer_id,
+                 start_block=spec.start, end_block=spec.end)
         logger.info("%s serving blocks [%d, %d) throughput=%.2f",
                     self.peer_id, spec.start, spec.end, self.throughput)
 
@@ -377,6 +380,7 @@ class ElasticStageServer:
             next_server_rtts=self._published_rtts(),
         ):
             self.registry.register(self._record())
+            _ev.emit("server_rejoin", peer=self.peer_id)
         _tm.get("server_heartbeats_total").inc()
         self.ping_next_servers()
 
@@ -418,18 +422,27 @@ class ElasticStageServer:
         logger.info("%s rebalancing away from [%d, %d)",
                     self.peer_id, self.spec.start, self.spec.end)
         old_spec = self.spec
+        _ev.emit("rebalance_decision", peer=self.peer_id,
+                 from_start=old_spec.start, from_end=old_spec.end)
+        t0 = time.monotonic()
         self.shutdown(deregister=True)
         try:
             self.start_serving()
-        except Exception:
+        except Exception as exc:
             # Failed mid-re-span (e.g. the params provider's checkpoint fetch):
             # restore the old span rather than stranding a torn-down server.
             logger.exception("%s: re-span failed, restoring [%d, %d)",
                              self.peer_id, old_spec.start, old_spec.end)
+            _ev.emit("rebalance_failed", peer=self.peer_id,
+                     error=f"{type(exc).__name__}: {exc}"[:200])
             self.load_span(old_spec)
             return False
         self.rebalances += 1
         _tm.get("server_rebalances_total").inc()
+        assert self.spec is not None
+        _ev.emit("rebalance_done", peer=self.peer_id,
+                 start_block=self.spec.start, end_block=self.spec.end,
+                 seconds=round(time.monotonic() - t0, 4))
         return True
 
     def next_check_delay(self) -> float:
@@ -443,6 +456,7 @@ class ElasticStageServer:
             self.registry.unregister(self.peer_id)
         else:
             self.registry.set_state(self.peer_id, ServerState.OFFLINE)
+        _ev.emit("server_leave", peer=self.peer_id)
         self.executor = None
         self.spec = None
 
@@ -529,6 +543,8 @@ class FixedStageServer:
     def start_serving(self) -> None:
         self.transport.add_peer(self.peer_id, self.executor)
         self.registry.register(self._record())
+        _ev.emit("server_join", peer=self.peer_id,
+                 start_block=self.spec.start, end_block=self.spec.end)
 
     def _published_rtts(self) -> Optional[Dict[str, float]]:
         # See ElasticStageServer._published_rtts: None = nothing to say,
@@ -556,9 +572,11 @@ class FixedStageServer:
             next_server_rtts=self._published_rtts(),
         ):
             self.registry.register(self._record())  # self-heal after expiry
+            _ev.emit("server_rejoin", peer=self.peer_id)
         _tm.get("server_heartbeats_total").inc()
         self.ping_next_servers()
 
     def shutdown(self) -> None:
         self.transport.remove_peer(self.peer_id)
         self.registry.unregister(self.peer_id)
+        _ev.emit("server_leave", peer=self.peer_id)

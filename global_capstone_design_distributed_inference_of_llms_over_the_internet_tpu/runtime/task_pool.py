@@ -32,6 +32,8 @@ import threading
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ..telemetry import events as _ev
+
 logger = logging.getLogger(__name__)
 
 # Task kinds, mirroring the three pools each backend owns
@@ -84,24 +86,49 @@ class PrioritizedTaskPool:
     chunking itself lives in ``StageExecutor`` chunked prefill).
     """
 
+    # Pressure hysteresis: `queue_pressure level=high` fires when the queue
+    # depth reaches HIGH_WATER, `level=normal` once it drains back below
+    # LOW_WATER — the flight-recorder signal that a stage fell behind.
+    HIGH_WATER = 16
+    LOW_WATER = 8
+
     def __init__(self, name: str, max_batch_size: int = 8192):
         self.name = name
         self.max_batch_size = max_batch_size
         self._heap: list[Task] = []
         self._lock = threading.Lock()
+        self._pressured = False
 
     def submit(self, task: Task) -> None:
         if task.size > self.max_batch_size:
+            _ev.emit("task_rejected", pool=self.name,
+                     reason=f"size {task.size} > max_batch_size "
+                            f"{self.max_batch_size}")
             raise TaskRejected(
                 f"pool {self.name}: task of size {task.size} exceeds "
                 f"max_batch_size {self.max_batch_size}"
             )
         with self._lock:
             heapq.heappush(self._heap, task)
+            depth = len(self._heap)
+            crossed = not self._pressured and depth >= self.HIGH_WATER
+            if crossed:
+                self._pressured = True
+        if crossed:
+            _ev.emit("queue_pressure", pool=self.name, level="high",
+                     depth=depth)
 
     def pop(self) -> Optional[Task]:
         with self._lock:
-            return heapq.heappop(self._heap) if self._heap else None
+            task = heapq.heappop(self._heap) if self._heap else None
+            depth = len(self._heap)
+            relaxed = self._pressured and depth < self.LOW_WATER
+            if relaxed:
+                self._pressured = False
+        if relaxed:
+            _ev.emit("queue_pressure", pool=self.name, level="normal",
+                     depth=depth)
+        return task
 
     def peek_key(self) -> Optional[Tuple[float, int]]:
         """Pool priority = its most urgent task (``task_pool.py:159-167``)."""
@@ -149,12 +176,15 @@ class StageRuntime:
     def submit(self, kind: str, fn: Callable[..., Any], *args: Any,
                size: int = 1, **priority_kwargs: Any) -> Future:
         if kind not in self.pools:
+            _ev.emit("task_rejected", pool=kind, reason="unknown task kind")
             raise TaskRejected(f"unknown task kind {kind!r}")
         priority = self.prioritizer.prioritize(kind, size, **priority_kwargs)
         task = Task(priority=priority, seq=next(self._seq), size=size,
                     fn=fn, args=args, future=Future())
         with self._submit_lock:
             if self._stop.is_set():
+                _ev.emit("task_rejected", pool=kind,
+                         reason="runtime is stopped")
                 raise TaskRejected("runtime is stopped")
             self.pools[kind].submit(task)
         self._work.release()

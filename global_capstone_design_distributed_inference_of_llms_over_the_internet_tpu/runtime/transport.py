@@ -31,6 +31,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from ..telemetry import catalog as _tm
+from ..telemetry import events as _ev
 from ..telemetry import get_tracer
 from .executor import StageExecutor
 from .messages import (
@@ -198,13 +199,24 @@ class LocalTransport(Transport):
                 self._fail_next[peer_id] = flake - 1
         if self.on_call is not None:
             self.on_call(peer_id, request)
+        trace_id = (request.trace or {}).get("trace_id") \
+            if isinstance(request.trace, dict) else None
         if executor is None or dead:
+            _ev.emit("transport_error", session_id=request.session_id,
+                     trace_id=trace_id, peer=peer_id, verb="forward",
+                     error="peer not reachable")
             raise PeerUnavailable(f"peer {peer_id} is not reachable")
         if flake > 0:
+            _ev.emit("transport_error", session_id=request.session_id,
+                     trace_id=trace_id, peer=peer_id, verb="forward",
+                     error="transient failure (injected)")
             raise PeerUnavailable(f"peer {peer_id} transient failure (injected)")
         if stall > 0.0:
             if timeout is not None and stall > timeout:
                 time.sleep(timeout)
+                _ev.emit("transport_timeout", session_id=request.session_id,
+                         trace_id=trace_id, peer=peer_id, verb="forward",
+                         timeout_s=timeout)
                 raise TimeoutError(
                     f"peer {peer_id} timed out after {timeout:.1f}s (stalled)"
                 )

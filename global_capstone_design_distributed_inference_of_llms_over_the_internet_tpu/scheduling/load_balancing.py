@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..telemetry import catalog as _tm
+from ..telemetry import events as _ev
 from .registry import ServerRecord, ServerState
 
 EPS = 1e-3
@@ -156,6 +157,8 @@ def should_choose_other_blocks(
     _tm.get("scheduler_rebalance_checks_total").inc()
     if balance_quality > 1.0:
         _tm.get("scheduler_rebalance_moves_total").inc()
+        _ev.emit("rebalance_recommended", peer=local_peer_id,
+                 quality=0.0, threshold=balance_quality)
         return True
     rng = rng or np.random.default_rng()
 
@@ -225,4 +228,6 @@ def should_choose_other_blocks(
     move = quality < balance_quality - EPS
     if move:
         _tm.get("scheduler_rebalance_moves_total").inc()
+        _ev.emit("rebalance_recommended", peer=local_peer_id,
+                 quality=round(quality, 4), threshold=balance_quality)
     return move

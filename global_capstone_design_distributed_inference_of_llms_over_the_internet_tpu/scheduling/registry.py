@@ -32,6 +32,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..telemetry import events as _ev
+
 DEFAULT_TTL = 45.0          # src/main.py:524
 DISCOVERY_POOL = 5          # random among 5 newest, src/rpc_transport.py:337-344
 
@@ -156,8 +158,11 @@ class PlacementRegistry:
             dead = [p for p, r in self._servers.items() if r.expired(now)]
             for p in dead:
                 del self._servers[p]
-            return [r for r in self._servers.values()
+            live = [r for r in self._servers.values()
                     if _model_ok(r, model)]
+        for p in dead:
+            _ev.emit("registry_expired", peer=p)
+        return live
 
     def live_servers(self, model: Optional[str] = None) -> List[ServerRecord]:
         return self._live(model=model)
@@ -167,8 +172,13 @@ class PlacementRegistry:
             rec = self._servers.get(peer_id)
             if rec is not None and rec.expired():
                 del self._servers[peer_id]
-                return None
-            return rec
+                rec = None
+                expired = True
+            else:
+                expired = False
+        if expired:
+            _ev.emit("registry_expired", peer=peer_id)
+        return rec
 
     def discover_stage(self, stage_index: int,
                        exclude: Sequence[str] = (),

@@ -35,6 +35,7 @@ import heapq
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 from ..telemetry import catalog as _tm
+from ..telemetry import events as _ev
 from .registry import ServerRecord, ServerState
 
 DEFAULT_RTT = 0.05  # seconds; unmeasured link penalty (WAN-scale, not free)
@@ -140,6 +141,8 @@ def plan_min_latency_route(
     hops.reverse()
     _tm.get("scheduler_route_plans_total").labels(planner="latency").inc()
     _tm.get("scheduler_route_hops").observe(len(hops))
+    _ev.emit("route_planned", planner="latency", hops=len(hops),
+             peers=",".join(h.record.peer_id for h in hops))
     return hops
 
 

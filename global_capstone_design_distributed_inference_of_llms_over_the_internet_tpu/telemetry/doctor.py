@@ -1,0 +1,351 @@
+"""Post-mortem doctor: turn flight-recorder dumps into a causal story.
+
+``--mode doctor`` feeds one or more JSONL dumps (written by
+telemetry/events.py on crash/signal/demand, or scraped live over the
+``dump-events`` wire verb) through this module, which:
+
+  * merges per-process event streams onto ONE timeline (wall-clock order —
+    cross-host skew is the reader's problem, as with spans);
+  * reconstructs per-session **failure chains**: trigger (timeout /
+    transport error / stage error) → failover → KV replay (with token
+    cost) → rebalance, correlated by session and trace id;
+  * surfaces **anomalies** from the metrics-registry snapshots embedded in
+    each dump (error counters that should be zero, retry/eviction rates);
+  * totals the **replay cost** each session paid for fault tolerance.
+
+Pure stdlib — the doctor must run on a laptop holding nothing but the
+dumps.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .events import load_dump
+
+# Events that can START a failure chain, with the human phrasing used in
+# the chain rendering.
+_TRIGGERS = {
+    "transport_timeout": "timeout",
+    "transport_error": "transport error",
+    "stage_timeout": "stage timeout",
+    "stage_error": "stage error",
+    "peer_failed": "peer failed",
+    "hop_retry": "retry",
+}
+# Events that CONTINUE a chain once triggered.
+_CHAIN = {
+    "hop_retry", "peer_failed", "failover", "replay_start", "replay_done",
+    "blacklist_amnesty", "rebalance_decision", "rebalance_done",
+    "rebalance_failed", "server_rejoin", "kv_eviction",
+}
+
+# Counter patterns in the embedded Prometheus exposition that should be
+# zero in a healthy run; non-zero values become anomalies.
+_ANOMALY_COUNTERS = (
+    ("client_retries_total", "hop retries"),
+    ("client_recoveries_total", "failovers to replacement servers"),
+    ("server_kv_alloc_failures_total", "KV allocations refused"),
+    ("server_kv_evictions_total", "idle sessions evicted by the KV arena"),
+    ("server_prefix_cache_evictions_total", "prefix-cache grains evicted"),
+)
+_ERR_REQ_RE = re.compile(
+    r'^server_requests_total\{outcome="(error|timeout)"\} ([0-9.e+]+)',
+    re.M)
+
+
+def load_dumps(paths: Sequence[str]) -> List[dict]:
+    return [load_dump(p) for p in paths]
+
+
+def merge_timeline(streams: Sequence[dict]) -> List[dict]:
+    """All events from every stream, stamped with their source process, in
+    wall-clock order (ties broken by per-process monotonic ts)."""
+    merged: List[dict] = []
+    for i, st in enumerate(streams):
+        pid = st.get("meta", {}).get("pid")
+        src = f"pid{pid}" if pid is not None else f"dump{i}"
+        for ev in st.get("events", ()):
+            d = dict(ev)
+            d["_src"] = src
+            merged.append(d)
+    merged.sort(key=lambda d: (d.get("wall", 0.0), d.get("ts", 0.0)))
+    return merged
+
+
+def _fields(ev: dict) -> dict:
+    return ev.get("fields") or {}
+
+
+def _describe(ev: dict) -> str:
+    """One human phrase per event, used inside chain arrows."""
+    f = _fields(ev)
+    name = ev.get("event")
+    if name in ("transport_timeout", "stage_timeout"):
+        peer = f.get("peer") or f.get("hop") or "?"
+        return f"{peer} timeout"
+    if name == "transport_error":
+        return f"{f.get('peer', '?')} transport error"
+    if name == "stage_error":
+        return f"stage error ({str(f.get('error', ''))[:60]})"
+    if name == "hop_retry":
+        return (f"retry {f.get('hop', '?')} attempt "
+                f"{f.get('attempt', '?')}")
+    if name == "peer_failed":
+        return f"peer {f.get('peer', '?')} failed on {f.get('hop', '?')}"
+    if name == "failover":
+        return (f"failover {f.get('hop', '?')}: {f.get('old_peer', '?')}"
+                f" -> {f.get('new_peer', '?')}")
+    if name == "replay_start":
+        return f"replay of {f.get('tokens', '?')} tokens begins"
+    if name == "replay_done":
+        return f"replay of {f.get('tokens', '?')} tokens"
+    if name == "blacklist_amnesty":
+        return f"blacklist amnesty on {f.get('hop', '?')}"
+    if name == "rebalance_decision":
+        return (f"rebalance decision on {f.get('peer', '?')} away from "
+                f"blocks [{f.get('from_start', '?')}, "
+                f"{f.get('from_end', '?')})")
+    if name == "rebalance_done":
+        return f"rebalance to blocks [{f.get('start_block', '?')}, " \
+               f"{f.get('end_block', '?')}) done"
+    if name == "rebalance_failed":
+        return "rebalance FAILED"
+    if name == "server_rejoin":
+        return f"server {f.get('peer', '?')} re-registered"
+    if name == "kv_eviction":
+        return f"KV evicted {f.get('sessions', '?')} sessions"
+    return str(name)
+
+
+def failure_chains(timeline: Sequence[dict],
+                   gap_s: float = 30.0) -> List[dict]:
+    """Group trigger+follow-up events into causal chains.
+
+    Correlation key: session id when present, else trace id, else the
+    source process — so a client's retry/failover/replay and a server's
+    rebalance land in the SAME chain when they share a session, and
+    orphan server-side chains (rebalance after a peer died) still group.
+    A chain closes after `gap_s` of silence on its key."""
+    chains: List[dict] = []
+    open_by_key: Dict[str, dict] = {}
+    for ev in timeline:
+        name = ev.get("event")
+        if name not in _TRIGGERS and name not in _CHAIN:
+            continue
+        key = (ev.get("session") or ev.get("trace")
+               or ev.get("_src", "?"))
+        ch = open_by_key.get(key)
+        if ch is not None and ev.get("wall", 0.0) - ch["last_wall"] > gap_s:
+            ch = None
+        if ch is None:
+            # A non-trigger opener (e.g. a rebalance with no visible
+            # trigger in this dump set) still gets its own chain.
+            ch = {"key": key, "events": [], "trigger": name}
+            ch["first_wall"] = ev.get("wall", 0.0)
+            ch["sessions"] = set()
+            ch["traces"] = set()
+            open_by_key[key] = ch
+            chains.append(ch)
+        ch["events"].append(ev)
+        ch["last_wall"] = ev.get("wall", 0.0)
+        if ev.get("session"):
+            ch["sessions"].add(ev["session"])
+        if ev.get("trace"):
+            ch["traces"].add(ev["trace"])
+    # A server-side consequence chain with no trigger of its own (e.g. a
+    # rebalance after a peer died — the server never saw the client's
+    # timeout) folds into the overlapping-or-adjacent triggered chain, so
+    # "timeout -> failover -> replay -> rebalance" reads as ONE story.
+    triggered = [c for c in chains if c["trigger"] in _TRIGGERS]
+    merged: List[dict] = []
+    for ch in chains:
+        if ch["trigger"] in _TRIGGERS:
+            merged.append(ch)
+            continue
+        host = None
+        for t in triggered:
+            if (t["first_wall"] - gap_s <= ch["first_wall"]
+                    <= t["last_wall"] + gap_s):
+                host = t
+                break
+        if host is None:
+            merged.append(ch)
+            continue
+        host["events"] = sorted(
+            host["events"] + ch["events"],
+            key=lambda d: (d.get("wall", 0.0), d.get("ts", 0.0)))
+        host["first_wall"] = min(host["first_wall"], ch["first_wall"])
+        host["last_wall"] = max(host["last_wall"], ch["last_wall"])
+        host["sessions"] |= ch["sessions"]
+        host["traces"] |= ch["traces"]
+    chains = merged
+    for ch in chains:
+        # Collapse repeats (N identical retries read as one arrow + count).
+        steps: List[str] = []
+        counts: List[int] = []
+        for ev in ch["events"]:
+            desc = _describe(ev)
+            if steps and steps[-1] == desc:
+                counts[-1] += 1
+            else:
+                steps.append(desc)
+                counts.append(1)
+        ch["chain"] = " -> ".join(
+            s if c == 1 else f"{s} (x{c})"
+            for s, c in zip(steps, counts))
+        ch["duration_s"] = round(ch["last_wall"] - ch["first_wall"], 3)
+    return chains
+
+
+def replay_costs(timeline: Sequence[dict]) -> Dict[str, int]:
+    """session id -> total tokens replayed onto replacement peers."""
+    costs: Dict[str, int] = {}
+    for ev in timeline:
+        if ev.get("event") != "replay_done":
+            continue
+        sid = ev.get("session") or "?"
+        try:
+            costs[sid] = costs.get(sid, 0) + int(
+                _fields(ev).get("tokens", 0))
+        except (TypeError, ValueError):
+            continue
+    return costs
+
+
+def _counter_total(exposition: str, name: str) -> float:
+    total = 0.0
+    for m in re.finditer(
+            r"^%s(?:\{[^}]*\})? ([0-9.e+\-]+)$" % re.escape(name),
+            exposition, re.M):
+        try:
+            total += float(m.group(1))
+        except ValueError:
+            continue
+    return total
+
+
+def anomalies(streams: Sequence[dict]) -> List[str]:
+    """Non-zero should-be-zero counters from each dump's embedded metrics
+    snapshot, worst first."""
+    out: List[Tuple[float, str]] = []
+    for st in streams:
+        met = st.get("metrics")
+        if not met:
+            continue
+        expo = met.get("exposition", "")
+        pid = st.get("meta", {}).get("pid", "?")
+        for name, what in _ANOMALY_COUNTERS:
+            v = _counter_total(expo, name)
+            if v > 0:
+                out.append((v, f"pid{pid}: {name}={int(v)} ({what})"))
+        for m in _ERR_REQ_RE.finditer(expo):
+            v = float(m.group(2))
+            if v > 0:
+                out.append((v, f"pid{pid}: server_requests_total"
+                               f"{{outcome={m.group(1)}}}={int(v)}"))
+    out.sort(key=lambda t: -t[0])
+    return [s for _, s in out]
+
+
+def diagnose(paths: Sequence[str]) -> str:
+    """The full human-readable report ``--mode doctor`` prints."""
+    return diagnose_streams(load_dumps(paths))
+
+
+def diagnose_streams(streams: Sequence[dict]) -> str:
+    """diagnose() over already-loaded streams (shared by the dump-file and
+    live-scrape ingestion paths)."""
+    timeline = merge_timeline(streams)
+    chains = failure_chains(timeline)
+    costs = replay_costs(timeline)
+    anoms = anomalies(streams)
+
+    lines: List[str] = []
+    lines.append(f"doctor: {len(streams)} dump(s), "
+                 f"{len(timeline)} event(s) on the merged timeline")
+    for st in streams:
+        meta = st.get("meta", {})
+        note = f" error={meta['error']}" if meta.get("error") else ""
+        lines.append(f"  - {st.get('path', '?')}: pid={meta.get('pid', '?')}"
+                     f" events={len(st.get('events', ()))}"
+                     f" dropped={meta.get('dropped', 0)}{note}")
+    lines.append("")
+    lines.append(f"failure chains ({len(chains)}):")
+    if not chains:
+        lines.append("  none — no failover/replay/rebalance activity "
+                     "recorded")
+    for i, ch in enumerate(chains, 1):
+        sess = ",".join(sorted(ch["sessions"])) or "-"
+        trc = ",".join(sorted(ch["traces"])) or "-"
+        lines.append(f"  [{i}] session={sess} trace={trc} "
+                     f"span={ch['duration_s']}s")
+        lines.append(f"      {ch['chain']}")
+    lines.append("")
+    lines.append("per-session replay cost:")
+    if not costs:
+        lines.append("  none — no KV replay occurred")
+    for sid, toks in sorted(costs.items(), key=lambda t: -t[1]):
+        lines.append(f"  {sid}: {toks} tokens re-computed on replacement "
+                     f"peers")
+    lines.append("")
+    lines.append(f"top anomalies ({len(anoms)}):")
+    if not anoms:
+        lines.append("  none — embedded metrics snapshots look clean")
+    for a in anoms[:10]:
+        lines.append(f"  {a}")
+    # Fatal tail: if any dump ends in a fatal_exception/signal, say so
+    # up top of the ending.
+    fatals = [ev for ev in timeline
+              if ev.get("event") in ("fatal_exception", "signal_dump")]
+    if fatals:
+        lines.append("")
+        lines.append("process terminations:")
+        for ev in fatals:
+            f = _fields(ev)
+            if ev.get("event") == "fatal_exception":
+                lines.append(f"  {ev.get('_src')}: fatal "
+                             f"{f.get('type', '?')}: "
+                             f"{str(f.get('message', ''))[:120]}")
+            else:
+                lines.append(f"  {ev.get('_src')}: dumped on "
+                             f"{f.get('signal', '?')}")
+    return "\n".join(lines) + "\n"
+
+
+def scrape_events(transport, peer_ids: Sequence[str]) -> List[dict]:
+    """Live-scrape variant: pull each peer's recorder over the
+    ``dump-events`` wire verb (TcpTransport.events_text) and parse it like
+    a dump file. Unreachable peers are skipped with a note in `meta`."""
+    import json as _json
+    streams: List[dict] = []
+    for pid in peer_ids:
+        try:
+            text = transport.events_text(pid)
+        except Exception as exc:               # noqa: BLE001 — per-peer
+            streams.append({"meta": {"peer": pid,
+                                     "error": f"{type(exc).__name__}: {exc}"},
+                            "metrics": None, "events": [],
+                            "path": f"live:{pid}"})
+            continue
+        meta: dict = {"peer": pid}
+        metrics: Optional[dict] = None
+        events: List[dict] = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = _json.loads(line)
+            except _json.JSONDecodeError:
+                continue
+            if d.get("record") == "_meta":
+                meta.update(d)
+            elif d.get("record") == "_metrics":
+                metrics = d
+            elif "event" in d:
+                events.append(d)
+        streams.append({"meta": meta, "metrics": metrics,
+                        "events": events, "path": f"live:{pid}"})
+    return streams

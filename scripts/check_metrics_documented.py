@@ -1,13 +1,16 @@
 #!/usr/bin/env python
-"""Fail (exit 1) when telemetry catalog and docs/OBSERVABILITY.md drift.
+"""Fail (exit 1) when telemetry catalogs and docs/OBSERVABILITY.md drift.
 
-Two directions:
+Covers BOTH catalogs, in both directions:
 
   * every metric in ``telemetry.catalog.SPEC`` must appear (backticked) in
     docs/OBSERVABILITY.md — new instrumentation cannot ship undocumented;
   * every backticked ``server_*``/``client_*``/``transport_*``/
     ``scheduler_*`` metric-shaped name in the doc must exist in the catalog
-    — stale docs cannot describe metrics that no longer exist.
+    — stale docs cannot describe metrics that no longer exist;
+  * every flight-recorder event in ``telemetry.events.EVENTS`` must appear
+    (backticked) in the doc's "Event log & doctor" section, and every
+    backticked token in that section's event table must be a real event.
 
 Pure stdlib + the dependency-free telemetry package (no jax import), so the
 check is fast enough to run as a tier-1 test
@@ -25,6 +28,10 @@ from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.
     SPEC,
     all_names,
 )
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.telemetry.events import (  # noqa: E402
+    EVENTS,
+    all_event_names,
+)
 
 DOC = REPO / "docs" / "OBSERVABILITY.md"
 
@@ -34,6 +41,11 @@ _DOC_METRIC_RE = re.compile(
     r"`((?:server|client|transport|scheduler)_[a-z0-9_]+"
     r"(?:_total|_seconds|_bytes|_ratio|_sessions|_hops))`"
 )
+
+# Event names in the doc's event table: backticked first-column cells.
+# Scoped to table rows (leading pipe) so prose backticks like `--mode
+# doctor` or field names stay out of scope.
+_DOC_EVENT_RE = re.compile(r"^\|\s*`([a-z][a-z0-9_]+)`", re.MULTILINE)
 
 
 def main() -> int:
@@ -46,6 +58,12 @@ def main() -> int:
     unknown = sorted(
         {m for m in _DOC_METRIC_RE.findall(text) if m not in SPEC}
     )
+    ev_undocumented = [n for n in all_event_names()
+                       if f"`{n}`" not in text]
+    ev_unknown = sorted(
+        {m for m in _DOC_EVENT_RE.findall(text)
+         if m not in EVENTS and m not in SPEC}
+    )
 
     if undocumented:
         print("metrics in telemetry/catalog.py missing from "
@@ -57,9 +75,20 @@ def main() -> int:
               "from telemetry/catalog.py:")
         for n in unknown:
             print(f"  {n}")
-    if undocumented or unknown:
+    if ev_undocumented:
+        print("events in telemetry/events.py missing from "
+              "docs/OBSERVABILITY.md:")
+        for n in ev_undocumented:
+            print(f"  {n}")
+    if ev_unknown:
+        print("event names documented in docs/OBSERVABILITY.md but absent "
+              "from telemetry/events.py:")
+        for n in ev_unknown:
+            print(f"  {n}")
+    if undocumented or unknown or ev_undocumented or ev_unknown:
         return 1
-    print(f"ok: {len(all_names())} metrics documented")
+    print(f"ok: {len(all_names())} metrics and {len(all_event_names())} "
+          "events documented")
     return 0
 
 

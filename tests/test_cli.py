@@ -168,6 +168,52 @@ def test_ring_sessions_sampled_cli_matches_oracle(capsys):
         f"{ring_texts} vs {singles}")
 
 
+def test_metrics_and_status_exit_nonzero_on_unreachable_server(capsys):
+    """A registered-but-dead server must not scrape clean: --mode metrics
+    exits 1 and --mode status exits 2, each naming the unreachable peer on
+    stderr so cron/CI notices even when other peers answered."""
+    import socket
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.net import (
+        RegistryServer,
+        RemoteRegistry,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.scheduling.registry import (
+        ServerRecord,
+    )
+
+    # Grab a free port and release it: a registered address nothing listens
+    # on (the just-crashed-server shape).
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_addr = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+
+    srv = RegistryServer(port=0)
+    srv.start()
+    try:
+        remote = RemoteRegistry(srv.address)
+        remote.register(ServerRecord(
+            peer_id="dead-peer", start_block=0, end_block=8,
+            final_stage=True, address=dead_addr))
+
+        rc = main(["--mode", "metrics", "--registry_addr", srv.address])
+        captured = capsys.readouterr()
+        assert rc == 1
+        assert "dead-peer" in captured.err
+        assert "unreachable" in captured.err
+
+        rc = main(["--mode", "status", "--registry_addr", srv.address,
+                   "--total_blocks", "8"])
+        captured = capsys.readouterr()
+        assert rc == 2                          # coverage fine, probe dead
+        assert "dead-peer" in captured.err
+        assert dead_addr in captured.err
+        assert "unreachable" in captured.err
+    finally:
+        srv.stop()
+
+
 def test_status_mode_coverage_summary(capsys):
     """--mode status prints live records + the per-block coverage summary
     (the reference's get_remote_module_infos log, src/dht_utils.py:227-240)

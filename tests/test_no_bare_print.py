@@ -1,0 +1,19 @@
+"""Tier-1 wrapper for scripts/check_no_bare_print.py: library modules must
+log through ``logging``, and main.py's stdout must route through its
+``_emit()`` helper — the CLI output boundary stays one grep-able function."""
+
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_no_bare_print_in_library_code():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_no_bare_print.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"bare print() drift:\n{proc.stdout}{proc.stderr}"
+    )
