@@ -413,6 +413,47 @@ def bench_prefix_cache(cfg, params, *, seq=8192, suffix=128, reps=12,
     }
 
 
+def bench_prefix_digest(cfg, *, seq=8192, grain=64, reps=20):
+    """Pure-host cost of the prefix-store chain digest over a DOWNSTREAM
+    stage's f32 hidden lane ([1, seq, D] activations — megabytes/prefill),
+    not just stage0's ~KB int32 token-id lane that bench_prefix_cache
+    exercises. This is serving-thread CPU paid on every store-enabled
+    prefill, hit AND miss, so it must stay a rounding error next to span
+    compute. Calls runtime.prefix_cache.chain_digests exactly as the
+    executor does (contiguous per-grain blocks of the host buffer)."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.prefix_cache import (
+        chain_digests,
+    )
+
+    d = cfg.hidden_size
+    n_grains = seq // grain
+    rng = np.random.default_rng(7)
+    hidden = rng.standard_normal((1, n_grains * grain, d)).astype(np.float32)
+    coords = (0, cfg.num_layers, 1, "float32", "bfloat16", None)
+    blocks = [np.ascontiguousarray(hidden[:, g * grain:(g + 1) * grain])
+              .tobytes() for g in range(n_grains)]
+    nbytes = sum(len(b) for b in blocks)
+    chain_digests(blocks, coords)  # warm (allocator, page-in)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        chain_digests(blocks, coords)
+        times.append(time.perf_counter() - t0)
+    ms = float(np.mean(times)) * 1e3
+    return {
+        "digest_ms_per_prefill": round(ms, 3),
+        "hashed_mb": round(nbytes / 2**20, 2),
+        "throughput_gb_s": round(nbytes / max(np.mean(times), 1e-9) / 2**30,
+                                 2),
+        "seq": seq, "grain": grain, "hidden_size": d,
+        "algo": "blake2b-128",
+        "note": ("host wall of chain_digests over an f32 hidden prefix — "
+                 "the downstream-stage lane; block serialization "
+                 "(tobytes) excluded, it is paid by the wire decode "
+                 "either way"),
+    }
+
+
 def bench_serving_batched(cfg, params, *, slots=8, max_len=512, prefill=64,
                           rounds=64, reps=2):
     """The SERVING path at full slots: runtime.batching's decode_batch, one
@@ -1256,10 +1297,12 @@ def main():
                                    prefill=8, rounds=8, reps=1)
         rp = bench_prefill(cfg, params, batch=2, seq=32, n1=2, n2=8, reps=1)
         rpx = bench_prefix_cache(cfg, params, seq=96, suffix=16, reps=2)
+        rpd = bench_prefix_digest(cfg, seq=128, grain=64, reps=3)
         rt = bench_telemetry_overhead(r["step_ms"])
         rrec = bench_recorder_overhead(r["step_ms"])
         cfgs = {"smoke": r, "smoke_serving": rs, "smoke_prefill": rp,
-                "smoke_prefix_cache": rpx, "smoke_telemetry_overhead": rt,
+                "smoke_prefix_cache": rpx, "smoke_prefix_digest": rpd,
+                "smoke_telemetry_overhead": rt,
                 "smoke_recorder_overhead": rrec}
         print(json.dumps({"metric": "smoke", "value": r["tokens_per_s"],
                           "unit": "tokens/s", "vs_baseline": 1.0,
@@ -1401,6 +1444,12 @@ def main():
             fcfg, fparams)
     except Exception as exc:
         results["flagship_prefix_cache_s8192"] = {"error": str(exc)[:200]}
+    # Downstream-stage digest lane: the same prefix hashed as f32 hidden
+    # states (what every non-entry stage pays), pure host CPU.
+    try:
+        results["flagship_prefix_digest_s8192"] = bench_prefix_digest(fcfg)
+    except Exception as exc:
+        results["flagship_prefix_digest_s8192"] = {"error": str(exc)[:200]}
     del fparams
 
     # BASELINE config #5: microbatched deep-pipeline decode (subprocess on

@@ -758,6 +758,12 @@ def _generate_and_report(args, generate_fn, cfg: ModelConfig,
         else:
             logger.warning("--speculative_k is ignored in --mode %s "
                            "(pipeline-client modes only)", args.mode)
+    if getattr(args, "deadline_s", None):
+        if supports_speculative:  # same gate: pipeline-client modes only
+            kw["deadline_s"] = args.deadline_s
+        else:
+            logger.warning("--deadline_s is ignored in --mode %s "
+                           "(pipeline-client modes only)", args.mode)
     res = generate_fn(prompt_ids, args.max_new_tokens, sampling=sampling,
                       eos_token_id=eos, **kw)
     text = tokenizer.decode(res.tokens)
@@ -808,7 +814,8 @@ def run_registry(args, cfg: ModelConfig, params) -> int:
     from .runtime.net import RegistryServer
 
     srv = RegistryServer(host=args.host, port=args.registry_port,
-                         ttl=args.ttl)
+                         ttl=args.ttl,
+                         allow_fault_injection=args.allow_fault_injection)
     srv.start()
     # Machine-readable handshake line (the reference printed the DHT maddr
     # for run_all.py to scrape, src/main.py:449-465).
@@ -910,7 +917,8 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
         engine = BatchedStageExecutor(
             cfg, spec, _stage_params(args, cfg, params, spec),
             slots=args.slots, max_len=args.max_session_len, dtype=kv_dtype,
-            prefix_cache_bytes=args.prefix_cache_mb << 20)
+            prefix_cache_bytes=args.prefix_cache_mb << 20,
+            model=_model_id(args))
         ex = BatchingStageAdapter(engine, peer_id=peer_id)
     else:
         ex = _SE(cfg, spec, _stage_params(args, cfg, params, spec),
@@ -939,7 +947,8 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
     runtime = None if (args.batched or args.sp > 1) else StageRuntime()
     srv = TcpStageServer(ex, host=args.host, port=args.rpc_port,
                          wire_dtype=args.wire_dtype, model=_model_id(args),
-                         runtime=runtime)
+                         runtime=runtime,
+                         allow_fault_injection=args.allow_fault_injection)
     srv.start()
     # --public_ip overrides the advertised address (the reference's
     # public-maddr-only advertising, component 21 / src/main.py:492-509).
@@ -1014,7 +1023,8 @@ def _run_serve_elastic(args, cfg: ModelConfig, params) -> int:
 
     srv = TcpStageServer(None, host=args.host, port=args.rpc_port,
                          wire_dtype=args.wire_dtype, peer_id=peer,
-                         model=_model_id(args), runtime=StageRuntime())
+                         model=_model_id(args), runtime=StageRuntime(),
+                         allow_fault_injection=args.allow_fault_injection)
     srv.start()
     advert = (f"{args.public_ip}:{srv.address.rsplit(':', 1)[1]}"
               if args.public_ip else srv.address)
@@ -1111,6 +1121,245 @@ def run_client(args, cfg: ModelConfig, params) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Chaos soak (--mode chaos): deterministic fault injection against the REAL
+# TCP data plane. Two generations with the same seed and prompt — one clean,
+# one under a seeded FaultPlan covering every side of the swarm — must emit
+# IDENTICAL tokens (recovery is exactly-once), and the doctor must
+# reconstruct every injected failure from the flight-recorder rings.
+# ---------------------------------------------------------------------------
+
+def chaos_soak(cfg, params, *, prompt_ids, max_new_tokens=10, seed=0,
+               splits=None, wire_dtype="f32", request_timeout=30.0,
+               registry_addr=None, sampling=None, deadline_probe=True,
+               stage_params=None) -> dict:
+    """Run the chaos soak and return a verdict dict (``ok``, ``problems``,
+    ``kinds_fired``, token lists, chain stats).
+
+    ``registry_addr=None`` boots a self-contained swarm in-process — real
+    TCP sockets, every role fault-armable. Passing an address instead
+    ATTACHES to an externally launched swarm (scripts/chaos_swarm.py: one
+    OS process per role, all started with --allow_fault_injection
+    --telemetry) and scrapes the servers' event rings over the wire."""
+    import collections as _collections
+    import os as _os
+
+    from .runtime.client import DeadlineExceeded
+    from .runtime.executor import StageExecutor as _SE
+    from .runtime.faults import FaultPlan, default_chaos_rules
+    from .runtime.net import (RegistryServer, RemoteRegistry, TcpStageServer,
+                              TcpTransport)
+    from .runtime.task_pool import StageRuntime
+    from .telemetry import doctor as _doc
+    from .telemetry import events as _events
+
+    # The soak IS a diagnostic: record regardless of --telemetry so the
+    # doctor cross-check below always has a local stream to read.
+    _events.get_recorder().enable()
+    if sampling is None:
+        # Greedy keeps the token-equality oracle independent of sampling
+        # RNG bookkeeping; seeded-sampling parity under failover is already
+        # pinned by the recovery tests.
+        sampling = SamplingParams(temperature=0.0)
+    if stage_params is None:
+        stage_params = lambda spec: slice_stage_params(cfg, params, spec)  # noqa: E731
+    plan = (StagePlan.from_splits(cfg.num_layers, splits) if splits
+            else StagePlan.even(cfg.num_layers, 4))
+
+    attach = registry_addr is not None
+    reg_server = None
+    servers = []
+    problems: List[str] = []
+    result: dict = {"attach": attach, "seed": seed}
+    try:
+        if not attach:
+            reg_server = RegistryServer(host="127.0.0.1", port=0,
+                                        allow_fault_injection=True)
+            reg_server.start()
+            registry_addr = reg_server.address
+        reg = RemoteRegistry(registry_addr)
+        if not attach:
+            for spec in plan.stages[1:]:
+                ex = _SE(cfg, spec, stage_params(spec),
+                         peer_id=f"chaos-s{spec.index}")
+                srv = TcpStageServer(ex, host="127.0.0.1", port=0,
+                                     wire_dtype=wire_dtype,
+                                     runtime=StageRuntime(),
+                                     allow_fault_injection=True)
+                srv.start()
+                rec = make_server_record(ex.peer_id, spec)
+                rec.address = srv.address
+                reg.register(rec)
+                servers.append(srv)
+        ex0 = _SE(cfg, plan.stages[0], stage_params(plan.stages[0]),
+                  peer_id="chaos-client")
+
+        def _client(tx):
+            # settle_seconds=0: recovery sleeps would dominate a soak whose
+            # faults are all deterministic one-shots.
+            return PipelineClient(cfg, plan, ex0, tx, reg,
+                                  request_timeout=request_timeout,
+                                  settle_seconds=0.0, seed=seed)
+
+        # --- clean reference run: nothing armed anywhere ---
+        tx1 = TcpTransport(reg, wire_dtype=wire_dtype)
+        try:
+            clean = _client(tx1).generate(
+                list(prompt_ids), max_new_tokens, sampling=sampling,
+                session_id="chaos-clean")
+        finally:
+            tx1.close()
+
+        # --- arm every side of the swarm with one seeded plan ---
+        recs = sorted(reg.live_servers(),
+                      key=lambda r: (r.start_block, r.peer_id))
+        peer_ids = [r.peer_id for r in recs]
+        rules = default_chaos_rules(peer_ids, seed=seed)
+        client_plan = FaultPlan([r for r in rules if r.side == "client"],
+                                seed=seed)
+        server_rules = [r for r in rules if r.side == "server"]
+        reg_rules = [r for r in rules if r.side == "registry"]
+        # Admin traffic goes over a transport that is NEVER armed — an
+        # armed transport's own frames would consume fault-rule matches.
+        admin = TcpTransport(reg, wire_dtype=wire_dtype)
+        for pid in peer_ids:
+            admin.install_fault_plan(pid, FaultPlan(server_rules, seed=seed))
+        reg._rpc({"verb": "fault",
+                  "plan": FaultPlan(reg_rules, seed=seed).to_dict()})
+        # Deterministic control-plane traffic: two heartbeats trip the
+        # `duplicate` rule (times=2) and two list calls walk `stale_registry`
+        # past nth=2 — the data-plane run alone need not send either verb.
+        for _ in range(2):
+            reg.heartbeat(peer_ids[0])
+            reg.live_servers()
+
+        # --- chaos run: same seed, same prompt, every plan armed ---
+        tx2 = TcpTransport(reg, wire_dtype=wire_dtype)
+        tx2.set_fault_plan(client_plan)
+        try:
+            chaos = _client(tx2).generate(
+                list(prompt_ids), max_new_tokens, sampling=sampling,
+                session_id="chaos-faulty")
+        finally:
+            tx2.set_fault_plan(None)  # drops pooled conns too
+            tx2.close()
+        result["tokens_clean"] = list(clean.tokens)
+        result["tokens_chaos"] = list(chaos.tokens)
+        if list(clean.tokens) != list(chaos.tokens):
+            problems.append(
+                f"token divergence under faults: clean={list(clean.tokens)} "
+                f"chaos={list(chaos.tokens)}")
+
+        # --- deadline probe: an expired budget is a TYPED client error ---
+        if deadline_probe:
+            tx3 = TcpTransport(reg, wire_dtype=wire_dtype)
+            try:
+                _client(tx3).generate(list(prompt_ids), 2, sampling=sampling,
+                                      session_id="chaos-deadline",
+                                      deadline_s=1e-6)
+                problems.append(
+                    "deadline_s=1e-6 generation finished instead of raising "
+                    "DeadlineExceeded")
+            except DeadlineExceeded:
+                result["deadline_probe"] = "raised DeadlineExceeded"
+            finally:
+                tx3.close()
+
+        # --- collect firing reports, then disarm for whoever runs next ---
+        client_firings = list(client_plan.report())
+        server_firings: List[dict] = []
+        for pid in peer_ids:
+            server_firings += admin.fault_report(pid)
+        reg_firings = list(reg._rpc(
+            {"verb": "fault", "action": "report"}).get("firings", []))
+        for pid in peer_ids:
+            admin.install_fault_plan(pid, None)
+        reg._rpc({"verb": "fault", "action": "clear"})
+
+        all_firings = client_firings + server_firings + reg_firings
+        fired = _collections.Counter(f["kind"] for f in all_firings)
+        result["kinds_fired"] = sorted(fired)
+        result["firings"] = dict(fired)
+        if len(fired) < 5:
+            problems.append(
+                f"only {len(fired)} distinct fault kinds fired "
+                f"({sorted(fired)}); the soak must cover >= 5")
+
+        # --- doctor cross-check: every injection must be reconstructable
+        # from the flight-recorder rings as part of a failure chain ---
+        streams = [{"meta": {"pid": _os.getpid()},
+                    "events": [ev.to_dict()
+                               for ev in _events.get_recorder().events()]}]
+        if attach:
+            streams += _doc.scrape_events(admin, peer_ids)
+        timeline = _doc.merge_timeline(streams)
+        chains = _doc.failure_chains(timeline)
+        in_chains = _collections.Counter(
+            ev.get("fields", {}).get("kind")
+            for ch in chains for ev in ch["events"]
+            if ev.get("event") == "fault_injected")
+        # Attach mode cannot read the registry process's ring (no
+        # dump-events verb there) — hold the doctor to what it CAN see.
+        accountable = client_firings + server_firings + (
+            [] if attach else reg_firings)
+        for kind, n in _collections.Counter(
+                f["kind"] for f in accountable).items():
+            if in_chains.get(kind, 0) < n:
+                problems.append(
+                    f"doctor chains account for {in_chains.get(kind, 0)}/{n} "
+                    f"'{kind}' injections")
+        fault_chains = [ch for ch in chains
+                        if any(ev.get("event") == "fault_injected"
+                               for ev in ch["events"])]
+        result["chains"] = len(chains)
+        result["fault_chains"] = len(fault_chains)
+        if not any("chaos-faulty" in ch["sessions"] for ch in fault_chains):
+            problems.append(
+                "no failure chain correlates an injected fault with the "
+                "chaos session (expected session 'chaos-faulty')")
+        admin.close()
+    finally:
+        for srv in servers:
+            srv.stop()
+        if reg_server is not None:
+            reg_server.stop()
+    result["problems"] = problems
+    result["ok"] = not problems
+    return result
+
+
+def run_chaos(args, cfg: ModelConfig, params) -> int:
+    from . import telemetry
+
+    telemetry.enable()
+    tokenizer = load_tokenizer(_remote_store(args).cache_dir
+                               if _is_remote(args.checkpoint)
+                               else args.checkpoint)
+    prompt_ids = [i % cfg.vocab_size for i in tokenizer.encode(args.prompt)]
+    splits = parse_splits(args.splits) if args.splits else None
+    res = chaos_soak(
+        cfg, params, prompt_ids=prompt_ids,
+        max_new_tokens=args.max_new_tokens, seed=args.seed, splits=splits,
+        wire_dtype=args.wire_dtype, request_timeout=args.request_timeout,
+        registry_addr=(args.registry_addr if args.chaos_attach else None))
+    _emit(f"\n=== Chaos soak (seed={res['seed']}, "
+          f"{'attached' if res['attach'] else 'in-process'} swarm) ===")
+    _emit(f"fault kinds fired : {', '.join(res.get('kinds_fired', []))}")
+    _emit(f"firing counts     : {res.get('firings', {})}")
+    _emit(f"tokens (clean)    : {res.get('tokens_clean')}")
+    _emit(f"tokens (chaos)    : {res.get('tokens_chaos')}")
+    _emit(f"deadline probe    : {res.get('deadline_probe', 'skipped')}")
+    _emit(f"failure chains    : {res.get('fault_chains', 0)} with faults "
+          f"/ {res.get('chains', 0)} total")
+    if res["ok"]:
+        _emit("CHAOS SOAK PASS: identical tokens under faults; doctor "
+              "reconstructed every injection")
+        return 0
+    for p in res["problems"]:
+        _emit(f"CHAOS SOAK FAIL: {p}")
+    return 1
+
+
+# ---------------------------------------------------------------------------
 # Argparse (reference flag table, src/main.py:776-819)
 # ---------------------------------------------------------------------------
 
@@ -1122,7 +1371,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mode",
                    choices=["local", "fused", "oracle",
                             "registry", "serve", "client", "status",
-                            "metrics", "doctor", "dcn-check"],
+                            "metrics", "doctor", "dcn-check", "chaos"],
                    default="local")
     p.add_argument("--telemetry", action="store_true",
                    help="enable the process-global metrics registry, "
@@ -1285,6 +1534,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ttl", type=float, default=45.0,
                    help="registry mode: record TTL seconds (reference 45s); "
                         "servers learn it from heartbeat responses")
+    p.add_argument("--allow_fault_injection", action="store_true",
+                   help="accept the `fault` admin verb: remote clients may "
+                        "install/clear/inspect a deterministic FaultPlan on "
+                        "this process (registry and serve roles). NEVER set "
+                        "on a production swarm — it lets any client that "
+                        "can dial the port inject faults")
+    p.add_argument("--chaos_attach", action="store_true",
+                   help="chaos mode: instead of booting an in-process "
+                        "swarm, attach to the externally launched one at "
+                        "--registry_addr (its roles must all run with "
+                        "--allow_fault_injection --telemetry; see "
+                        "scripts/chaos_swarm.py)")
+    p.add_argument("--deadline_s", type=float, default=None,
+                   help="end-to-end wall-clock budget for the WHOLE "
+                        "generation: each hop ships the seconds remaining, "
+                        "servers refuse already-expired work, and "
+                        "exhaustion raises DeadlineExceeded instead of "
+                        "burning retries (pipeline-client modes only)")
     p.add_argument("--wire_dtype", choices=["bf16", "f32"], default="bf16",
                    help="activation compression on the wire")
     # Multi-host DCN cluster (runtime.dcn; SURVEY.md §7.1 layer 7)
@@ -1643,7 +1910,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_doctor(args)  # no model needed
     cfg, params = load_model(args)
     run = {"local": run_local, "fused": run_fused, "oracle": run_oracle,
-           "serve": run_serve, "client": run_client}[args.mode]
+           "serve": run_serve, "client": run_client,
+           "chaos": run_chaos}[args.mode]
     if args.profile:
         # SURVEY.md §5.1: the reference only had wall-clock prints; we keep
         # its metric names AND produce a real device trace.

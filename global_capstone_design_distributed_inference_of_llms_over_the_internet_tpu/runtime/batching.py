@@ -118,9 +118,13 @@ class BatchedStageExecutor:
         max_len: int = 2048,
         dtype=jnp.float32,
         prefix_cache_bytes: int = 0,
+        model: Optional[str] = None,
     ):
         self.cfg = cfg
         self.spec = spec
+        # Model tag for prefix-store digest coords: two models with the same
+        # span indices must never share cache entries (multi-model serving).
+        self.model = model
         # Engine-side fused-QKV layout (one projection matmul per layer,
         # bitwise-identical — models/transformer.fuse_qkv_params).
         from ..models.transformer import fuse_qkv_params
@@ -386,8 +390,14 @@ class BatchedStageExecutor:
         n_grains = min(prefix_len, t - 1) // grain
         if n_grains <= 0:
             return self._prefill_full(session_id, x)
+        # Batch dim rides the coords because stored segments are [L, G, ...]
+        # slices of a fixed-batch slot layout; model tag because digests are
+        # content-addressed across sessions, and two models' identical token
+        # prefixes must not alias (the session executor's coords already
+        # carry req.model — this engine learns it at construction).
         coords = ("slot", self.spec.start, self.spec.end,
-                  str(x_np.dtype), str(self.dtype))
+                  str(x_np.dtype), str(self.dtype),
+                  x_np.shape[0], self.model)
         blocks = [np.ascontiguousarray(x_np[:, g * grain:(g + 1) * grain])
                   .tobytes() for g in range(n_grains)]
         keys = chain_digests(blocks, coords)

@@ -27,9 +27,11 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import random
+import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -43,7 +45,7 @@ from ..telemetry import catalog as _tm
 from ..telemetry import events as _ev
 from .executor import StageExecutionError, StageExecutor
 from .messages import StageRequest, StageResponse, clip_generated
-from .transport import PeerUnavailable, Transport
+from .transport import DeadlineExceeded, PeerUnavailable, Transport
 
 logger = logging.getLogger(__name__)
 
@@ -96,6 +98,117 @@ def _soft_filter(items, pred):
 
 class NoRouteError(RuntimeError):
     """No live servers cover the required span (route computation failed)."""
+
+
+class _BreakerOpen(PeerUnavailable):
+    """Synthetic dial refusal: the peer's circuit breaker is open. A
+    PeerUnavailable subclass so the recovery wrapper's existing failover
+    path handles it — but it is NOT counted as a failure observation (the
+    peer was never dialed)."""
+
+
+class CircuitBreaker:
+    """Per-peer circuit breaker for the client's recovery wrapper.
+
+    The 3-attempt retry loop treats every failure the same; without a
+    breaker, a flapping peer gets re-dialed (connect timeout + replay) on
+    every route that includes it, multiplying recovery latency swarm-wide.
+    Classic state machine instead:
+
+      closed     normal; `threshold` CONSECUTIVE failures open it.
+      open       dials are skipped (no connection attempt) until the
+                 backoff elapses: ``base * 2**(n_opens-1)`` capped at
+                 ``max_backoff_s``, plus seeded jitter so a fleet of
+                 clients doesn't re-probe a recovering server in
+                 lockstep.
+      half_open  backoff elapsed: exactly ONE probe call is let through.
+                 Success closes the breaker (full readmission — no
+                 blacklist clear needed); failure re-opens with doubled
+                 backoff.
+
+    Transitions emit breaker_open/breaker_half_open/breaker_close events
+    and count in ``client_breaker_transitions_total{state=...}``; every
+    skipped dial counts in ``client_breaker_open_skips_total``. `now` is
+    injectable so tests drive the clock instead of sleeping.
+    """
+
+    def __init__(self, threshold: int = 3, base_backoff_s: float = 0.5,
+                 max_backoff_s: float = 30.0, jitter: float = 0.1,
+                 seed: int = 0,
+                 now: Callable[[], float] = time.monotonic,
+                 metrics=None):
+        self.threshold = threshold
+        self.base_backoff_s = base_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.jitter = jitter
+        self.now = now
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        # peer -> {"state", "fails", "opened_at", "backoff", "opens"}
+        self._peers: Dict[str, dict] = {}
+        self._m_transitions = _tm.get("client_breaker_transitions_total",
+                                      metrics)
+        self._m_skips = _tm.get("client_breaker_open_skips_total", metrics)
+
+    def _st(self, peer_id: str) -> dict:
+        return self._peers.setdefault(
+            peer_id, {"state": "closed", "fails": 0, "opened_at": 0.0,
+                      "backoff": 0.0, "opens": 0})
+
+    def state(self, peer_id: str) -> str:
+        with self._lock:
+            return self._peers.get(peer_id, {}).get("state", "closed")
+
+    def allow(self, peer_id: str) -> bool:
+        """May the caller dial this peer now? Open + backoff pending -> no
+        (counted as a skipped dial); open + backoff elapsed -> yes, as the
+        half-open single probe; half_open with the probe already granted ->
+        no (one probe at a time, or N callers would stampede the
+        recovering peer the breaker exists to protect)."""
+        with self._lock:
+            st = self._st(peer_id)
+            if st["state"] == "closed":
+                return True
+            if st["state"] == "open":
+                if self.now() - st["opened_at"] < st["backoff"]:
+                    self._m_skips.inc()
+                    return False
+                st["state"] = "half_open"
+                self._m_transitions.labels(state="half_open").inc()
+                _ev.emit("breaker_half_open", peer=peer_id,
+                         opens=st["opens"])
+                return True
+            # half_open: the single probe is already in flight.
+            self._m_skips.inc()
+            return False
+
+    def record_success(self, peer_id: str) -> None:
+        with self._lock:
+            st = self._st(peer_id)
+            was = st["state"]
+            st.update(state="closed", fails=0, backoff=0.0, opens=0)
+        if was != "closed":
+            self._m_transitions.labels(state="close").inc()
+            _ev.emit("breaker_close", peer=peer_id)
+
+    def record_failure(self, peer_id: str) -> None:
+        with self._lock:
+            st = self._st(peer_id)
+            st["fails"] += 1
+            if st["state"] != "half_open" and st["fails"] < self.threshold:
+                return
+            # Threshold reached (closed) or the half-open probe failed:
+            # (re-)open with exponentially grown, jittered backoff.
+            st["opens"] += 1
+            backoff = min(self.base_backoff_s * (2 ** (st["opens"] - 1)),
+                          self.max_backoff_s)
+            backoff *= 1.0 + self._rng.uniform(0.0, self.jitter)
+            st.update(state="open", opened_at=self.now(), backoff=backoff,
+                      fails=0)
+            opens, b = st["opens"], backoff
+        self._m_transitions.labels(state="open").inc()
+        _ev.emit("breaker_open", peer=peer_id, opens=opens,
+                 backoff_s=round(b, 4))
 
 
 def _merge_entries(a: "JournalEntry", b: "JournalEntry") -> "JournalEntry":
@@ -278,6 +391,13 @@ class PipelineClient:
         # client's private counters.
         self._m_route_plans = _tm.get("scheduler_route_plans_total")
         self._m_route_hops = _tm.get("scheduler_route_hops")
+        self._m_deadline = _tm.get("client_deadline_expired_total",
+                                   self.metrics)
+        # Per-peer circuit breaker: bounds how often the recovery loop
+        # re-dials a flapping peer (consecutive-failure threshold -> open
+        # with exponential backoff + jitter -> half-open single probe ->
+        # close). Seeded with the client seed so chaos runs reproduce.
+        self.breaker = CircuitBreaker(seed=seed, metrics=self.metrics)
         # Last-REQUEST views kept for API compatibility (status displays and
         # tests read them); cumulative aggregates live in self.metrics.
         self.last_prefill_stage_times: Dict[str, float] = {}
@@ -484,7 +604,18 @@ class PipelineClient:
         key = (kind, min_context, affinity)
         if refresh or key not in self._routes:
             while len(self._routes) >= 64:
-                self._routes.pop(next(iter(self._routes)))
+                # Evict LRU among AFFINITY-CARRYING keys only. The
+                # affinity=None entries are the per-(kind, min_context)
+                # fallback routes — a bounded handful that every
+                # non-affinity session shares — and evicting one to make
+                # room for yet another one-off prompt-head digest forces a
+                # full route recompute on the next plain step. Distinct
+                # digests are what's unbounded; only they pay eviction.
+                victim = next((k for k in self._routes if k[2] is not None),
+                              None)
+                if victim is None:
+                    break  # all entries are exempt fallback routes
+                self._routes.pop(victim)
             self._routes[key] = self._compute_route(kind, min_context,
                                                     affinity)
         else:
@@ -559,14 +690,51 @@ class PipelineClient:
             return None
         return pr[start:end]
 
+    def _deadline_budget(self, deadline_at: Optional[float],
+                         session_id: str, *, trace_id=None,
+                         peer: Optional[str] = None) -> Optional[float]:
+        """Remaining end-to-end budget (seconds), or None when the session
+        has no deadline. An EXPIRED budget raises the typed client error
+        here — before any hop is dialed — with the catalogued
+        ``deadline_expired`` event; the counterpart of the server-side
+        ``deadline_rejected`` refusal."""
+        if deadline_at is None:
+            return None
+        remaining = deadline_at - time.monotonic()
+        if remaining <= 0.0:
+            self._m_deadline.inc()
+            _ev.emit("deadline_expired", session_id=session_id,
+                     trace_id=trace_id, peer=peer,
+                     over_s=round(-remaining, 6))
+            raise DeadlineExceeded(
+                f"session {session_id}: deadline exceeded "
+                f"({-remaining:.3f}s past) before dialing "
+                f"{peer or 'the next hop'}")
+        return remaining
+
     def _call_with_recovery(self, hop: Hop, req: StageRequest) -> StageResponse:
-        """3-attempt failover (``src/rpc_transport.py:587-668``)."""
+        """3-attempt failover (``src/rpc_transport.py:587-668``), gated by
+        the per-peer circuit breaker: an open breaker turns the dial into a
+        synthetic retryable failure (failover to a replacement, no
+        connection attempt), and only real observations feed the breaker's
+        state machine."""
         last_exc: Optional[Exception] = None
         touched = self._session_peers.setdefault(req.session_id, set())
         for attempt in range(MAX_ATTEMPTS):
             touched.add(hop.peer_id)
             try:
-                return self.transport.call(hop.peer_id, req, timeout=self.request_timeout)
+                if not self.breaker.allow(hop.peer_id):
+                    raise _BreakerOpen(
+                        f"peer {hop.peer_id}: circuit breaker open")
+                resp = self.transport.call(hop.peer_id, req,
+                                           timeout=self.request_timeout)
+                self.breaker.record_success(hop.peer_id)
+                return resp
+            except DeadlineExceeded:
+                # Terminal by design: the caller's budget is spent, and a
+                # failover attempt can only spend more of it. Never counts
+                # against the peer (it did the right thing by refusing).
+                raise
             # Retryable taxonomy: connectivity faults + server-side session
             # loss (StageExecutionError — failover+replay rebuilds the KV).
             # Deliberately NOT the reference's broad RuntimeError/ValueError
@@ -574,6 +742,9 @@ class PipelineClient:
             # would blacklist every healthy replica in turn.
             except (PeerUnavailable, TimeoutError, ConnectionError,
                     StageExecutionError) as exc:
+                if not isinstance(exc, _BreakerOpen):
+                    # A skipped dial is not evidence about the peer.
+                    self.breaker.record_failure(hop.peer_id)
                 last_exc = exc
                 self._m_retries.inc()
                 trace_id = (req.trace or {}).get("trace_id") \
@@ -669,6 +840,7 @@ class PipelineClient:
               min_context: Optional[int] = None,
               prefix_len: int = 0,
               affinity: Optional[str] = None,
+              deadline_at: Optional[float] = None,
               trace_ctx=None) -> StageResponse:
         """Send the activation through every remote hop; return the final
         hop's response: a sampled token, (num_logprobs > 0, beam mode)
@@ -691,6 +863,7 @@ class PipelineClient:
                 step_seed=step_seed, stage_times=stage_times,
                 draft_tokens=draft_tokens,
                 start_from_position=start_from_position,
+                deadline_at=deadline_at,
                 trace_ctx=trace_ctx,
             )
         tracer = get_tracer()
@@ -706,6 +879,13 @@ class PipelineClient:
                                                min_context=min_context,
                                                affinity=affinity)):
                 wire_ctx = root.wire_context(hop=i) if root else None
+                # Per-hop deadline stamp: the budget REMAINING right now —
+                # earlier hops' service time has already been spent from it.
+                # Expiry raises the typed client error before dialing.
+                budget = self._deadline_budget(
+                    deadline_at, session_id,
+                    trace_id=root.trace_id if root else None,
+                    peer=hop.peer_id)
                 req = StageRequest(
                     session_id=session_id,
                     hidden=cur,
@@ -725,6 +905,7 @@ class PipelineClient:
                     prompts=self._hop_prompts(session_id, hop, cur_len),
                     prefix_len=prefix_len if is_prefill else 0,
                     trace=wire_ctx,
+                    deadline_budget_s=budget,
                 )
                 hop_span = tracer.start_span(
                     f"hop:{hop.key}", trace_id=root.trace_id,
@@ -793,7 +974,8 @@ class PipelineClient:
                        sampling: SamplingParams, generated: Sequence[int],
                        step_seed: int,
                        draft_tokens: Optional[Tuple[int, ...]] = None,
-                       start_from_position: Optional[int] = None) -> StageRequest:
+                       start_from_position: Optional[int] = None,
+                       deadline_at: Optional[float] = None) -> StageRequest:
         nxt = []
         for h in hops[1:]:
             rec = self.registry.get(h.peer_id)
@@ -812,6 +994,8 @@ class PipelineClient:
             next_servers=tuple(nxt),
             draft_tokens=draft_tokens,
             start_from_position=start_from_position,
+            deadline_budget_s=self._deadline_budget(
+                deadline_at, session_id, peer=hops[0].peer_id),
         )
 
     def _replay_chain(self, hops: List[Hop], session_id: str,
@@ -860,6 +1044,7 @@ class PipelineClient:
                     stage_times: Dict[str, float],
                     draft_tokens: Optional[Tuple[int, ...]] = None,
                     start_from_position: Optional[int] = None,
+                    deadline_at: Optional[float] = None,
                     trace_ctx=None) -> StageResponse:
         tracer = get_tracer()
         own_root = trace_ctx is None
@@ -872,7 +1057,8 @@ class PipelineClient:
                 max_length=max_length, sampling=sampling, generated=generated,
                 step_seed=step_seed, stage_times=stage_times,
                 draft_tokens=draft_tokens,
-                start_from_position=start_from_position, root=root)
+                start_from_position=start_from_position,
+                deadline_at=deadline_at, root=root)
         finally:
             if own_root:
                 root.end()
@@ -884,7 +1070,8 @@ class PipelineClient:
                            stage_times: Dict[str, float],
                            draft_tokens: Optional[Tuple[int, ...]],
                            start_from_position: Optional[int],
-                           root) -> StageResponse:
+                           deadline_at: Optional[float] = None,
+                           root=None) -> StageResponse:
         tracer = get_tracer()
         touched = self._session_peers.setdefault(session_id, set())
         last_exc: Optional[Exception] = None
@@ -914,12 +1101,25 @@ class PipelineClient:
                 self._routes.clear()
                 continue
             touched.update(h.peer_id for h in hops)
+            if not self.breaker.allow(hops[0].peer_id):
+                # Entry hop's breaker is open: skipping the dial is a
+                # retryable failure — blacklist it for this chain and
+                # re-route (readmission comes from the breaker's half-open
+                # probe, not from clearing the blacklist wholesale).
+                last_exc = _BreakerOpen(
+                    f"peer {hops[0].peer_id}: circuit breaker open")
+                self._m_retries.inc()
+                self.failed_peers.setdefault(
+                    hops[0].key, set()).add(hops[0].peer_id)
+                self._routes.clear()
+                continue
             req = self._chain_request(
                 hops, hidden, seq_len, cur_len, session_id,
                 is_prefill=is_prefill, is_replay=attempt > 0,
                 max_length=max_length, sampling=sampling, generated=generated,
                 step_seed=step_seed, draft_tokens=draft_tokens,
                 start_from_position=start_from_position,
+                deadline_at=deadline_at,
             )
             req.trace = root.wire_context(hop=0) if root else None
             chain_span = tracer.start_span(
@@ -933,8 +1133,14 @@ class PipelineClient:
                     # the chain spans len(hops) computes before responding
                     timeout=self.request_timeout * max(1, len(hops)),
                 )
+                self.breaker.record_success(hops[0].peer_id)
+            except DeadlineExceeded:
+                chain_span.end(error="deadline")
+                raise  # terminal: retrying spends a budget already blown
             except (PeerUnavailable, TimeoutError, ConnectionError,
                     StageExecutionError) as exc:
+                self.breaker.record_failure(
+                    getattr(exc, "peer_id", None) or hops[0].peer_id)
                 chain_span.end(error=repr(exc))
                 last_exc = exc
                 self._m_retries.inc()
@@ -1004,6 +1210,7 @@ class PipelineClient:
         speculative_k: int = 0,
         draft_fn=None,
         deep_prompts=None,
+        deadline_s: Optional[float] = None,
     ) -> GenerationResult:
         """``deep_prompts`` ([total_blocks, pre_seq, D]) enables
         inference-time deep prompt tuning: each step, every server injects
@@ -1021,7 +1228,13 @@ class PipelineClient:
         token-identical to non-speculative greedy decoding; temperature>0
         uses rejection-sampling verification (accept draft i with prob
         p_i(d_i), resample the residual on reject), which preserves the
-        sampling distribution exactly."""
+        sampling distribution exactly.
+
+        ``deadline_s`` sets an end-to-end wall-clock budget for the WHOLE
+        generation: each hop is stamped with the seconds remaining, servers
+        refuse already-expired work, and an exhausted budget raises
+        `DeadlineExceeded` (non-retryable) instead of burning retries on a
+        response the caller has stopped waiting for."""
         session_id = session_id or f"sess-{time.monotonic_ns():x}"
         if deep_prompts is not None:
             self._session_prompts[session_id] = np.asarray(deep_prompts)
@@ -1034,7 +1247,9 @@ class PipelineClient:
                 prompt_ids, max_new_tokens, sampling=sampling,
                 eos_token_id=eos_token_id, session_id=session_id,
                 max_length=max_length, speculative_k=speculative_k,
-                draft_fn=draft_fn)
+                draft_fn=draft_fn,
+                deadline_at=(time.monotonic() + deadline_s
+                             if deadline_s is not None else None))
             return result
         finally:
             # Error paths included: a failed session must not leak its
@@ -1056,6 +1271,7 @@ class PipelineClient:
         max_length: Optional[int],
         speculative_k: int,
         draft_fn,
+        deadline_at: Optional[float] = None,
     ) -> GenerationResult:
         sampling = sampling or SamplingParams()
         prompt_len = len(prompt_ids)
@@ -1116,7 +1332,7 @@ class PipelineClient:
                 is_prefill=True, max_length=max_length, sampling=sampling,
                 generated=generated, step_seed=self.seed, stage_times=times,
                 kind=kind, min_context=max_length, prefix_len=prompt_len,
-                affinity=affinity, trace_ctx=root,
+                affinity=affinity, deadline_at=deadline_at, trace_ctx=root,
             )
         finally:
             root.end()
@@ -1173,7 +1389,7 @@ class PipelineClient:
                     draft_tokens=drafts if drafts else None,
                     start_from_position=spos,
                     kind=kind, min_context=max_length, affinity=affinity,
-                    trace_ctx=step_span,
+                    deadline_at=deadline_at, trace_ctx=step_span,
                 )
             finally:
                 step_span.end()

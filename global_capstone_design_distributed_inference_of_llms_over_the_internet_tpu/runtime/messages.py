@@ -113,6 +113,14 @@ class StageRequest:
     # pass-through (push-chain relays propagate it unchanged so every hop of
     # a chain lands in the same trace).
     trace: Optional[dict] = None
+    # End-to-end deadline budget: seconds REMAINING when this request left
+    # its sender. The client stamps the remaining budget per hop (and the
+    # push-chain relay re-stamps it minus its own service time), so any hop
+    # observing an exhausted budget rejects instead of computing tokens the
+    # caller already gave up on (typed DeadlineExceeded client-side; a
+    # ``deadline_rejected`` event server-side). None = no deadline (default;
+    # the pre-deadline wire format, headers stay byte-identical).
+    deadline_budget_s: Optional[float] = None
 
 
 @dataclasses.dataclass

@@ -53,9 +53,15 @@ from ..telemetry import exposition as _texp
 from ..telemetry import get_registry as _get_metrics_registry
 from ..telemetry import get_tracer
 from .executor import StageExecutionError, StageExecutor
+from .faults import SITE_KINDS, FaultPlan, FaultSocket
 from .messages import BackwardRequest, StageRequest, StageResponse
 from .task_pool import StageRuntime, TaskRejected
-from .transport import PeerUnavailable, PushChainError, Transport
+from .transport import (
+    DeadlineExceeded,
+    PeerUnavailable,
+    PushChainError,
+    Transport,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -281,6 +287,11 @@ def _request_header(req: StageRequest, tensor_meta: dict,
         # Trace context (telemetry.tracing): absent unless the client runs
         # with tracing on, so legacy peers see byte-identical headers.
         hdr["trace"] = req.trace
+    if req.deadline_budget_s is not None:
+        # End-to-end deadline budget (seconds remaining at send time);
+        # absent unless the caller set a deadline, so legacy peers see
+        # byte-identical headers.
+        hdr["deadline_budget_s"] = req.deadline_budget_s
     # Model identity echo: the data-plane counterpart of the reference's
     # model-prefixed DHT keys (src/dht_utils.py:20-31). A mis-routed request
     # (wrong model's server) must fail loudly, not produce garbage activations.
@@ -327,6 +338,7 @@ def _header_to_request(h: dict, payload: bytes) -> StageRequest:
         prompts=pr,
         prefix_len=h.get("prefix_len", 0),
         trace=h.get("trace"),
+        deadline_budget_s=h.get("deadline_budget_s"),
     )
 
 
@@ -360,23 +372,55 @@ class _FramedTcpServer:
         active_lock = threading.Lock()
         active: set = set()
         self._active_lock, self._active = active_lock, active
+        # Chaos layer (runtime.faults). `fault_plan` is the injection hook:
+        # None (the default) keeps the serving path on the raw socket with a
+        # single attribute read per frame — zero overhead. A plan is armed
+        # either in-process (tests) or over the wire via the `fault` admin
+        # verb, which is refused unless the operator opted in with
+        # `allow_fault_injection` (--allow_fault_injection).
+        self.fault_plan: Optional[FaultPlan] = None
+        self.fault_side = "server"
+        self.allow_fault_injection = False
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                sock = self.request
                 while True:
                     try:
-                        header, payload = _recv_frame(self.request)
+                        header, payload = _recv_frame(sock)
                     except (ConnectionError, OSError):
                         return
+                    plan = outer.fault_plan
+                    if plan is not None:
+                        if not isinstance(sock, FaultSocket):
+                            # Arm send-side faults for this connection. The
+                            # wrapper hashes/compares as the raw socket, so
+                            # per-connection state keyed on the dispatch sock
+                            # (stream registries) survives the upgrade and
+                            # `_on_connection_closed(raw)` still matches.
+                            sock = FaultSocket(self.request, plan,
+                                               side=outer.fault_side)
+                        sock.ctx_verb = header.get("verb")
+                        sock.ctx_session = header.get("session_id")
+                        rule = plan.fire(
+                            "dispatch", ("accept_hang", "delay"),
+                            side=outer.fault_side, verb=sock.ctx_verb,
+                            session=sock.ctx_session)
+                        if rule is not None:
+                            time.sleep(rule.delay_s)
+                            if rule.kind == "accept_hang":
+                                # Swallow the request: the client sees a
+                                # stalled-then-dead connection, never a reply.
+                                return
                     try:
-                        outer._dispatch(self.request, header, payload)
+                        outer._dispatch(sock, header, payload)
                     except (ConnectionError, OSError):
                         return
                     except Exception as exc:  # report, keep serving
                         logger.exception("request failed")
                         try:
-                            _send_frame(self.request,
+                            _send_frame(sock,
                                         {"verb": "error", "message": str(exc)})
                         except OSError:
                             return
@@ -424,6 +468,28 @@ class _FramedTcpServer:
 
     def _on_connection_closed(self, sock) -> None:
         """Hook: a connection's handler finished (socket about to close)."""
+
+    def _fault_admin(self, header: dict) -> dict:
+        """The `fault` admin verb: install/clear/inspect this process's
+        FaultPlan over the wire. Refused unless the operator started the
+        process with fault injection allowed — a production swarm must not
+        accept chaos from any client that can dial it."""
+        if not self.allow_fault_injection:
+            return {"verb": "error",
+                    "message": "fault injection disabled "
+                               "(start with --allow_fault_injection)"}
+        action = header.get("action", "install")
+        if action == "clear":
+            self.fault_plan = None
+            return {"verb": "ok", "installed": False}
+        if action == "report":
+            plan = self.fault_plan
+            return {"verb": "fault_report",
+                    "installed": plan is not None,
+                    "firings": [] if plan is None else plan.report()}
+        self.fault_plan = FaultPlan.from_dict(header.get("plan") or {})
+        return {"verb": "ok", "installed": True,
+                "rules": len(self.fault_plan.rules)}
 
 
 # ---------------------------------------------------------------------------
@@ -499,7 +565,8 @@ class TcpStageServer(_FramedTcpServer):
                  compute_timeout: float = 120.0,
                  owns_runtime: bool = True,
                  peer_id: Optional[str] = None,
-                 model: Optional[str] = None):
+                 model: Optional[str] = None,
+                 allow_fault_injection: bool = False):
         # May be swapped at runtime (elastic servers re-span in place) or
         # None during a re-span window — requests then get a retryable
         # stage error and clients fail over / retry.
@@ -536,6 +603,9 @@ class TcpStageServer(_FramedTcpServer):
         # elastic teardown of server A would kill server B's compute.
         self.owns_runtime = owns_runtime
         super().__init__(host, port)
+        # After super().__init__ (which defaults it off): opt-in gate for
+        # the `fault` admin verb (runtime.faults chaos layer).
+        self.allow_fault_injection = allow_fault_injection
 
     def _compute(self, kind: str, fn, *args, size: int = 1,
                  timeout: Optional[float] = None):
@@ -669,6 +739,12 @@ class TcpStageServer(_FramedTcpServer):
                 "lines": _ev.get_recorder().render_jsonl(
                     registry=_get_metrics_registry()),
             })
+            return
+        if verb == "fault":
+            # Chaos-layer admin (runtime.faults): install/clear/report this
+            # server's FaultPlan. Executor-less (a re-spanning server still
+            # takes plans) and gated by allow_fault_injection.
+            _send_frame(sock, self._fault_admin(header))
             return
         # Snapshot: the elastic rebalance thread may null/swap self.executor
         # at any moment; every later access in this request must see ONE
@@ -853,6 +929,7 @@ class TcpStageServer(_FramedTcpServer):
             start_from_position=header.get("start_from_position"),
             prefix_len=header.get("prefix_len", 0),
             trace=header.get("trace"),
+            deadline_budget_s=header.get("deadline_budget_s"),
         )
         self._run_forward(sock, ex, req, stream=state,
                           step_timeout=state["step_timeout"])
@@ -886,6 +963,34 @@ class TcpStageServer(_FramedTcpServer):
                 outcome=outcome, detail=detail,
                 span=f"[{req.start_block},{req.end_block})",
                 replay=int(req.is_replay) or None)
+
+        if req.deadline_budget_s is not None:
+            # End-to-end deadline budget: the first hop that observes an
+            # exhausted budget refuses the work — computing tokens the
+            # caller already gave up on wastes the swarm's scarce resource
+            # (and on a push chain would waste EVERY downstream hop too).
+            remaining = req.deadline_budget_s - (time.monotonic() - t_req)
+            if remaining <= 0.0:
+                _log("deadline", f"budget {req.deadline_budget_s:.3f}s")
+                m_requests.labels(outcome="error").inc()
+                _tm.get("server_deadline_rejected_total").inc()
+                _ev.emit("deadline_rejected", session_id=req.session_id,
+                         trace_id=_trace_id(req), peer=ex.peer_id,
+                         budget_s=req.deadline_budget_s,
+                         waited_s=round(time.monotonic() - t_req, 6))
+                span.end(error="deadline")
+                _send_frame(sock, {
+                    "verb": "error", "kind": "stage", "peer": ex.peer_id,
+                    "deadline_expired": True,
+                    "message": f"deadline budget exhausted "
+                               f"({req.deadline_budget_s:.3f}s remaining "
+                               f"on arrival)"})
+                return
+            # Cap the compute wait by what's left of the caller's deadline:
+            # a queue stall past the budget surfaces as a stage timeout
+            # instead of a reply nobody is waiting for.
+            step_timeout = (remaining if step_timeout is None
+                            else min(step_timeout, remaining))
 
         try:
             resp = self._compute("inference", ex.forward, req,
@@ -972,6 +1077,14 @@ class TcpStageServer(_FramedTcpServer):
                 end_block=nxt.get("end_block"),
                 next_servers=tuple(req.next_servers[1:]),
             )
+            if req.deadline_budget_s is not None:
+                # Forward the REMAINING budget: this hop's service time has
+                # already been spent from the caller's deadline, and the
+                # next hop must judge expiry against what's actually left.
+                nreq = dataclasses.replace(
+                    nreq,
+                    deadline_budget_s=(req.deadline_budget_s
+                                       - (time.monotonic() - t_req)))
             try:
                 rh, rp = self._relay(nxt, nreq)
             except (ConnectionError, OSError, TimeoutError) as exc:
@@ -1145,6 +1258,12 @@ class TcpTransport(Transport):
         # (peer_id, session_id) -> {"snap", "sock", "window", "returns_tokens"}
         self._streams: Dict[Tuple[str, str], dict] = {}
         self._lock = threading.Lock()
+        # Chaos layer (runtime.faults): client-side injection hook. None
+        # (default) keeps dial/send on raw sockets; arm via set_fault_plan.
+        self.fault_plan: Optional[FaultPlan] = None
+        # peer_id -> cached `info` reply (None = probe failed; fail open).
+        # Capability gating for mixed-version swarms — see _capabilities.
+        self._peer_caps: Dict[str, Optional[dict]] = {}
         # Wire telemetry (global registry; no-op unless enabled). Byte
         # counters cover tensor payloads, not frame/header overhead —
         # consistent with LocalTransport's accounting.
@@ -1174,6 +1293,15 @@ class TcpTransport(Transport):
             sock = self._conns.get(peer_id)
         if sock is not None:
             return sock
+        plan = self.fault_plan
+        if plan is not None and plan.fire(
+                "connect", SITE_KINDS["connect"], side="client",
+                peer=peer_id) is not None:
+            # Injected dial refusal: surfaces through the transport's normal
+            # unreachable mapping so recovery/breaker paths see the real
+            # taxonomy, not a synthetic one.
+            raise PeerUnavailable(
+                f"cannot reach {peer_id}: connection refused (injected)")
         host, port = self._addr(peer_id)
         try:
             sock = socket.create_connection((host, port),
@@ -1181,6 +1309,8 @@ class TcpTransport(Transport):
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError as exc:
             raise PeerUnavailable(f"cannot reach {peer_id} at {host}:{port}: {exc}")
+        if plan is not None:
+            sock = FaultSocket(sock, plan, side="client", peer=peer_id)
         with self._lock:
             self._conns[peer_id] = sock
         return sock
@@ -1232,11 +1362,50 @@ class TcpTransport(Transport):
                 and request.draft_tokens is None and not request.is_replay
                 and request.prompts is None)
 
+    def _capabilities(self, peer_id: str) -> Optional[dict]:
+        """The peer's cached `info` reply (capability flags: version, lora,
+        ...), probed once per peer. FAIL OPEN: an unreachable or erroring
+        probe caches None so capability gating skips rather than adding a
+        second failure mode to the call path — only a SUCCESSFUL info reply
+        that lacks a capability blocks a call."""
+        with self._lock:
+            if peer_id in self._peer_caps:
+                return self._peer_caps[peer_id]
+        try:
+            caps: Optional[dict] = self.info(peer_id)
+            if not isinstance(caps, dict) or caps.get("verb") != "info":
+                caps = None
+        except (PeerUnavailable, TimeoutError, ConnectionError, OSError,
+                WireError):
+            caps = None
+        with self._lock:
+            self._peer_caps[peer_id] = caps
+        return caps
+
     def call(self, peer_id: str, request: StageRequest,
              timeout: Optional[float] = None) -> StageResponse:
+        if request.train and request.lora:
+            # Mixed-version swarms: a pre-LoRA server would silently drop
+            # the adapters from the frame tail (unknown header keys) and
+            # train the base span instead — reject BEFORE shipping, with an
+            # error naming the peer and the fix. StageExecutionError keeps
+            # it in the retryable taxonomy, so the trainer fails over to a
+            # replica that does advertise the capability.
+            caps = self._capabilities(peer_id)
+            if caps is not None and not caps.get("lora"):
+                exc = StageExecutionError(
+                    f"peer {peer_id} (info version "
+                    f"{caps.get('version', 0)}) does not advertise LoRA "
+                    f"support; upgrade that server or detach the adapters "
+                    f"for this span")
+                exc.peer_id = peer_id
+                raise exc
         if self._streamable(request):
             return self._call_stream(peer_id, request, timeout)
         sock = self._connect(peer_id)
+        if self.fault_plan is not None and isinstance(sock, FaultSocket):
+            sock.ctx_verb = "train_forward" if request.train else "forward"
+            sock.ctx_session = request.session_id
         self._m_calls.labels(
             verb="train" if request.train else "forward").inc()
         try:
@@ -1327,6 +1496,9 @@ class TcpTransport(Transport):
                 tuple(json.dumps(n, sort_keys=True)
                       for n in request.next_servers))
         sock = self._connect(peer_id)
+        if self.fault_plan is not None and isinstance(sock, FaultSocket):
+            sock.ctx_verb = "step"
+            sock.ctx_session = request.session_id
         try:
             sock.settimeout(timeout)
             with self._lock:
@@ -1374,6 +1546,8 @@ class TcpTransport(Transport):
                 hdr["start_from_position"] = request.start_from_position
             if request.trace is not None:
                 hdr["trace"] = request.trace
+            if request.deadline_budget_s is not None:
+                hdr["deadline_budget_s"] = request.deadline_budget_s
             if st["returns_tokens"] and (
                     st["window"] != list(request.generated_tokens)[-50:]):
                 # Window drifted (tokens were produced off-stream): re-seed
@@ -1463,6 +1637,14 @@ class TcpTransport(Transport):
                 span=span,
             )
         if verb == "error":
+            if header.get("deadline_expired"):
+                # BEFORE the kind="stage" mapping: an exhausted deadline is
+                # terminal, and letting it surface as a retryable stage
+                # error would burn more of the caller's (already-blown)
+                # budget on failover attempts.
+                raise DeadlineExceeded(
+                    header.get("message",
+                               f"peer {peer_id}: deadline budget exhausted"))
             if header.get("kind") == "push":
                 raise PushChainError(header.get("peer", "?"),
                                      header.get("message", "push failed"))
@@ -1590,6 +1772,48 @@ class TcpTransport(Transport):
                 f"unexpected response verb {header.get('verb')!r}")
         return header.get("lines", "")
 
+    # -- chaos layer (runtime.faults) -----------------------------------
+
+    def set_fault_plan(self, plan: Optional[FaultPlan]) -> None:
+        """Arm (or with None, clear) a FaultPlan on THIS transport's own
+        dial/send path. Drops pooled connections so socket wrapping always
+        matches the armed state — a cleared plan must not keep firing
+        through wrappers left on old sockets."""
+        self.close()
+        self.fault_plan = plan
+
+    def _fault_rpc(self, peer_id: str, header: dict,
+                   timeout: float = 5.0) -> dict:
+        sock = self._connect(peer_id)
+        try:
+            sock.settimeout(timeout)
+            _send_frame(sock, header)
+            h, _ = _recv_frame(sock)
+        except (ConnectionError, OSError) as exc:
+            self._drop(peer_id)
+            raise PeerUnavailable(f"peer {peer_id}: {exc}")
+        if h.get("verb") == "error":
+            raise RuntimeError(f"peer {peer_id}: {h.get('message')}")
+        return h
+
+    def install_fault_plan(self, peer_id: str,
+                           plan: Optional[FaultPlan]) -> dict:
+        """Install (or with None, clear) a FaultPlan on a REMOTE peer via
+        the `fault` admin verb. The peer refuses unless it was started with
+        fault injection allowed (--allow_fault_injection)."""
+        if plan is None:
+            return self._fault_rpc(peer_id,
+                                   {"verb": "fault", "action": "clear"})
+        return self._fault_rpc(peer_id,
+                               {"verb": "fault", "plan": plan.to_dict()})
+
+    def fault_report(self, peer_id: str) -> list:
+        """The remote peer's fault-firing log (list of dicts): what its
+        armed plan actually injected, in order — the chaos soak diffs this
+        against the doctor's reconstructed failure chains."""
+        return self._fault_rpc(
+            peer_id, {"verb": "fault", "action": "report"}).get("firings", [])
+
     def reach_check(self, peer_id: str, target: str,
                     timeout: float = 8.0) -> bool:
         """Ask `peer_id` whether IT can dial `target` ("host:port") — the
@@ -1664,16 +1888,36 @@ class RegistryServer(_FramedTcpServer):
     """JSON-over-TCP registry service backed by a PlacementRegistry."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 ttl: float = 45.0):
+                 ttl: float = 45.0, allow_fault_injection: bool = False):
         self.registry = PlacementRegistry(ttl=ttl)
         super().__init__(host, port)
+        self.fault_side = "registry"
+        self.allow_fault_injection = allow_fault_injection
 
     def _dispatch(self, sock, header: dict, payload: bytes) -> None:
         del payload
+        plan = self.fault_plan
+        if plan is not None:
+            # Control-plane chaos beyond the generic dispatch hooks (which
+            # already cover accept_hang/delay for side="registry"):
+            #   duplicate      — process the verb TWICE, reply once
+            #                    (at-least-once delivery; the registry's
+            #                    verbs are idempotent, which this proves);
+            #   stale_registry — rewind every record's freshness before
+            #                    answering (a lagging/partitioned view).
+            rule = plan.fire("registry", SITE_KINDS["registry"],
+                             side="registry", verb=header.get("verb"))
+            if rule is not None:
+                if rule.kind == "duplicate":
+                    self._handle_verb(header)
+                else:
+                    self.registry.age_records(rule.age_s)
         _send_frame(sock, self._handle_verb(header))
 
     def _handle_verb(self, h: dict) -> dict:
         verb = h.get("verb")
+        if verb == "fault":
+            return self._fault_admin(h)
         if verb == "register":
             self.registry.register(_dict_to_rec(h["record"]))
             # The server's TTL rides every write response so peers pace

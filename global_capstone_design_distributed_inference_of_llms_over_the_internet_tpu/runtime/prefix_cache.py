@@ -82,8 +82,15 @@ class PrefixEntry:
 
 def chain_digests(prefix_bytes_per_grain: List[bytes], coords: tuple) -> List[str]:
     """Rolling digests d_1..d_K over grain-sized byte blocks: d_k commits to
-    coords + ALL bytes through grain k (one pass, snapshot per grain)."""
-    h = hashlib.sha256(repr(coords).encode())
+    coords + ALL bytes through grain k (one pass, snapshot per grain).
+
+    blake2b with a 16-byte digest, not sha256: the input is the full f32
+    hidden lane of the prefix (grain 64 × D floats per block — megabytes
+    for long system prompts), and this runs on the serving thread of every
+    store-enabled prefill, hits AND misses. blake2b is ~2x sha256 on large
+    buffers with no SHA-NI dependence, and 128 bits keeps collisions
+    negligible for a cache key (not a security boundary)."""
+    h = hashlib.blake2b(repr(coords).encode(), digest_size=16)
     out = []
     for blk in prefix_bytes_per_grain:
         h.update(blk)

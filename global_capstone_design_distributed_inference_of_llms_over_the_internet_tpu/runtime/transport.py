@@ -55,6 +55,16 @@ class PushChainError(ConnectionError):
         self.peer_id = peer_id
 
 
+class DeadlineExceeded(RuntimeError):
+    """The request's end-to-end deadline budget ran out (client-side before
+    a hop was dialed, or a server rejected already-expired work).
+
+    Deliberately NOT a TimeoutError/ConnectionError subclass: those are
+    RETRYABLE in the recovery taxonomy, and retrying an exhausted deadline
+    only burns more of the caller's (already-blown) budget. The recovery
+    wrapper re-raises this immediately."""
+
+
 class Transport(abc.ABC):
     """Client-side view: submit a request to a named peer."""
 
@@ -201,6 +211,18 @@ class LocalTransport(Transport):
             self.on_call(peer_id, request)
         trace_id = (request.trace or {}).get("trace_id") \
             if isinstance(request.trace, dict) else None
+        if request.deadline_budget_s is not None \
+                and request.deadline_budget_s <= 0.0:
+            # Same contract as TcpStageServer: expired work is refused at
+            # the first hop that observes it, never computed.
+            _ev.emit("deadline_rejected", session_id=request.session_id,
+                     trace_id=trace_id, peer=peer_id,
+                     budget_s=request.deadline_budget_s, waited_s=0.0)
+            self._m_requests.labels(outcome="error").inc()
+            _tm.get("server_deadline_rejected_total").inc()
+            raise DeadlineExceeded(
+                f"peer {peer_id}: deadline budget exhausted "
+                f"({request.deadline_budget_s:.3f}s remaining)")
         if executor is None or dead:
             _ev.emit("transport_error", session_id=request.session_id,
                      trace_id=trace_id, peer=peer_id, verb="forward",

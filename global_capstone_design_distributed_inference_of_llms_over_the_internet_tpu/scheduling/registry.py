@@ -148,6 +148,20 @@ class PlacementRegistry:
             if rec is not None:
                 rec.state = state
 
+    def age_records(self, seconds: float) -> int:
+        """Rewind every record's freshness by `seconds` (timestamp AND
+        expiry), as if the registry stopped seeing heartbeats that long ago.
+        Fault-injection surface (``runtime.faults`` kind
+        ``stale_registry``): models a partitioned/lagging control plane —
+        discovery keeps answering from aged records until TTL expiry culls
+        them, exactly the staleness window a real outage produces. Returns
+        the number of records aged."""
+        with self._lock:
+            for rec in self._servers.values():
+                rec.timestamp -= seconds
+                rec.expires_at -= seconds
+            return len(self._servers)
+
     # -- queries ------------------------------------------------------------
 
     def _live(self, now: Optional[float] = None,

@@ -95,6 +95,9 @@ SPEC: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Sequence[float]]]] = {
         COUNTER, "Registry heartbeats published.", (), None),
     "server_rebalances_total": (
         COUNTER, "Span migrations executed by the elastic server.", (), None),
+    "server_deadline_rejected_total": (
+        COUNTER, "Requests refused because their deadline budget was "
+                 "already spent on arrival/queueing.", (), None),
     # -- client -------------------------------------------------------------
     "client_ttft_seconds": (
         HISTOGRAM, "Time to first token (prefill walk + first sample).",
@@ -114,6 +117,16 @@ SPEC: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Sequence[float]]]] = {
         COUNTER, "generate() calls completed.", (), None),
     "client_tokens_generated_total": (
         COUNTER, "Tokens emitted to callers.", (), None),
+    "client_breaker_transitions_total": (
+        COUNTER, "Per-peer circuit-breaker state transitions "
+                 "(open|half_open|close).", ("state",), None),
+    "client_breaker_open_skips_total": (
+        COUNTER, "Dial attempts skipped because the peer's breaker was "
+                 "open (each skip is a reconnect the backoff prevented).",
+        (), None),
+    "client_deadline_expired_total": (
+        COUNTER, "Hops abandoned client-side because the end-to-end "
+                 "deadline budget ran out.", (), None),
     # -- transport ----------------------------------------------------------
     "transport_calls_total": (
         COUNTER, "Transport round trips, per verb.", ("verb",), None),
@@ -124,6 +137,9 @@ SPEC: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Sequence[float]]]] = {
         COUNTER, "Payload bytes received from peers.", (), None),
     "transport_rtt_seconds": (
         HISTOGRAM, "Measured ping round-trip time.", (), FAST_BUCKETS),
+    "transport_faults_injected_total": (
+        COUNTER, "Chaos-layer fault firings, per kind (runtime.faults).",
+        ("kind",), None),
     # -- scheduler ----------------------------------------------------------
     "scheduler_route_plans_total": (
         COUNTER, "Route computations, per planner (greedy|latency).",

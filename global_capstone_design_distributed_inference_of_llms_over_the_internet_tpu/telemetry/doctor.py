@@ -33,12 +33,16 @@ _TRIGGERS = {
     "stage_error": "stage error",
     "peer_failed": "peer failed",
     "hop_retry": "retry",
+    "fault_injected": "injected fault",
+    "deadline_expired": "deadline expired",
+    "deadline_rejected": "deadline rejected",
 }
 # Events that CONTINUE a chain once triggered.
 _CHAIN = {
     "hop_retry", "peer_failed", "failover", "replay_start", "replay_done",
     "blacklist_amnesty", "rebalance_decision", "rebalance_done",
     "rebalance_failed", "server_rejoin", "kv_eviction",
+    "breaker_open", "breaker_half_open", "breaker_close",
 }
 
 # Counter patterns in the embedded Prometheus exposition that should be
@@ -116,6 +120,21 @@ def _describe(ev: dict) -> str:
         return f"server {f.get('peer', '?')} re-registered"
     if name == "kv_eviction":
         return f"KV evicted {f.get('sessions', '?')} sessions"
+    if name == "fault_injected":
+        where = f.get("peer") or f.get("side", "?")
+        return f"injected {f.get('kind', '?')} at {where}"
+    if name == "breaker_open":
+        return (f"breaker OPEN on {f.get('peer', '?')} "
+                f"(backoff {f.get('backoff_s', '?')}s)")
+    if name == "breaker_half_open":
+        return f"breaker half-open probe of {f.get('peer', '?')}"
+    if name == "breaker_close":
+        return f"breaker closed on {f.get('peer', '?')}"
+    if name == "deadline_expired":
+        return f"deadline expired client-side ({f.get('over_s', '?')}s over)"
+    if name == "deadline_rejected":
+        return (f"{f.get('peer', '?')} rejected expired deadline "
+                f"(budget {f.get('budget_s', '?')}s)")
     return str(name)
 
 

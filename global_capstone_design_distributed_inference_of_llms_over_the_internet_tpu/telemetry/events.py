@@ -130,6 +130,31 @@ EVENTS: Dict[str, Tuple[str, str, str]] = {
     "transport_timeout": (
         "transport", ERROR,
         "A transport round trip exceeded its deadline (fields: peer)."),
+    "fault_injected": (
+        "transport", WARN,
+        "The chaos layer fired a scheduled fault (fields: kind, site, "
+        "peer, verb; runtime.faults.FaultPlan)."),
+    # -- circuit breaker / deadline budgets ----------------------------------
+    "breaker_open": (
+        "client", WARN,
+        "A peer's circuit breaker opened after consecutive failures "
+        "(fields: peer, failures, backoff_s)."),
+    "breaker_half_open": (
+        "client", INFO,
+        "A peer's backoff elapsed; the breaker admits ONE probe call "
+        "(fields: peer)."),
+    "breaker_close": (
+        "client", INFO,
+        "A half-open probe succeeded; the peer is readmitted (fields: "
+        "peer)."),
+    "deadline_expired": (
+        "client", ERROR,
+        "The end-to-end deadline budget ran out client-side before a hop "
+        "was dialed (fields: hop, budget_s)."),
+    "deadline_rejected": (
+        "server", ERROR,
+        "A server refused already-expired work instead of computing dead "
+        "tokens (fields: peer, budget_s, waited_s)."),
     # -- server request handling --------------------------------------------
     "stage_error": (
         "server", ERROR,

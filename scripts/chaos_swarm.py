@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Chaos soak against a REAL multi-process swarm: registry + stage servers
+launched as separate OS processes (every role started with
+--allow_fault_injection --telemetry), then ``--mode chaos --chaos_attach``
+drives the soak over the wire — clean run, seeded FaultPlan installation on
+every side, faulty run, token-equality check, and the doctor cross-check
+against the servers' scraped event rings.
+
+This is the full-fidelity variant of the in-process soak that runs in
+tier-1 (tests/test_faults.py): here a mid-frame reset really crosses a
+process boundary and the doctor really merges rings from N processes.
+
+Usage (tiny random-weight gpt2 by default)::
+
+    python scripts/chaos_swarm.py --model gpt2 --splits 4,8 \
+        --prompt "hello" --max_new_tokens 10 --seed 0
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+MAIN = "global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.main"
+
+
+def registry_list(addr):
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.net import (
+        RemoteRegistry,
+    )
+
+    return RemoteRegistry(addr).live_servers()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="gpt2")
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--splits", default="4,8")
+    p.add_argument("--prompt", default="hello world")
+    p.add_argument("--max_new_tokens", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--registry_port", type=int, default=31345)
+    p.add_argument("--startup_timeout", type=float, default=600.0)
+    args = p.parse_args()
+
+    num_stages = len(args.splits.split(","))  # stages 1..N (0 = client)
+    reg_addr = f"127.0.0.1:{args.registry_port}"
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if env.get("JAX_PLATFORMS") == "cpu":
+        # A CPU swarm must not route compiles through the axon TPU plugin's
+        # remote compile service (see run_swarm.py) — empty pool-ips keeps
+        # every subprocess compiling locally.
+        env["PALLAS_AXON_POOL_IPS"] = ""
+    procs = []
+
+    log_dir = tempfile.mkdtemp(prefix="chaos_swarm_")
+
+    def spawn(role_args, log_name):
+        log = open(os.path.join(log_dir, f"{log_name}.log"), "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", MAIN] + role_args,
+            cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT,
+        )
+        procs.append((proc, log))
+        return proc
+
+    common = ["--model", args.model]
+    if args.checkpoint:
+        common += ["--checkpoint", args.checkpoint]
+
+    try:
+        # Every role consents to chaos: the `fault` admin verb is refused
+        # unless the process opts in, and --telemetry arms the event rings
+        # the doctor scrapes afterwards.
+        spawn(["--mode", "registry",
+               "--registry_port", str(args.registry_port),
+               "--allow_fault_injection", "--telemetry"], "chaos_registry")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                registry_list(reg_addr)
+                break
+            except OSError:
+                time.sleep(0.3)
+        else:
+            raise SystemExit("registry did not come up")
+        print(f"registry up at {reg_addr}")
+
+        for i in range(1, num_stages + 1):
+            spawn(common + ["--mode", "serve", "--splits", args.splits,
+                            "--registry_addr", reg_addr, "--stage", str(i),
+                            "--allow_fault_injection", "--telemetry"],
+                  f"chaos_stage{i}")
+
+        deadline = time.time() + args.startup_timeout
+        while time.time() < deadline:
+            try:
+                recs = [r for r in registry_list(reg_addr)
+                        if str(r.state) == "online"]
+            except OSError:
+                recs = []
+            if len(recs) >= num_stages:
+                break
+            for proc, _ in procs:
+                if proc.poll() is not None:
+                    raise SystemExit(
+                        f"a swarm process exited early (rc={proc.returncode})"
+                        " — see logs in " + log_dir)
+            time.sleep(1.0)
+        else:
+            raise SystemExit("servers did not register in time — "
+                             "see logs in " + log_dir)
+        print(f"{num_stages} stage servers registered; starting chaos soak")
+
+        rc = subprocess.call(
+            [sys.executable, "-m", MAIN] + common
+            + ["--mode", "chaos", "--chaos_attach", "--splits", args.splits,
+               "--registry_addr", reg_addr, "--prompt", args.prompt,
+               "--max_new_tokens", str(args.max_new_tokens),
+               "--seed", str(args.seed), "--telemetry"],
+            cwd=REPO, env=env)
+        return rc
+    finally:
+        for proc, log in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGINT)
+        for proc, log in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            log.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -314,6 +314,38 @@ def test_failure_chains_collapse_repeats_and_split_on_gaps():
     assert chains[1]["chain"] == "p2 timeout"
 
 
+def test_failure_chains_cover_faults_breaker_and_deadline():
+    """The chaos-layer vocabulary: an injected fault triggers a chain, the
+    breaker lifecycle rides it as links, and a deadline rejection opens its
+    own story — all correlated by session."""
+    tl = [
+        _mk("fault_injected", 1.0, session="s",
+            fields={"kind": "reset_mid_frame", "peer": "p1",
+                    "site": "send"}),
+        _mk("hop_retry", 1.1, session="s",
+            fields={"hop": "stage1", "attempt": 1}),
+        _mk("breaker_open", 1.2, session="s",
+            fields={"peer": "p1", "backoff_s": 0.5}),
+        _mk("breaker_half_open", 1.9, session="s", fields={"peer": "p1"}),
+        _mk("breaker_close", 2.0, session="s", fields={"peer": "p1"}),
+        # 100 s later, a different session's budget dies on arrival.
+        _mk("deadline_rejected", 102.0, session="t",
+            fields={"peer": "p2", "budget_s": -0.1}),
+        _mk("deadline_expired", 102.1, session="t",
+            fields={"over_s": 0.2}),
+    ]
+    chains = doctor.failure_chains(tl)
+    assert len(chains) == 2
+    assert chains[0]["sessions"] == {"s"}
+    assert chains[0]["chain"] == (
+        "injected reset_mid_frame at p1 -> retry stage1 attempt 1 "
+        "-> breaker OPEN on p1 (backoff 0.5s) "
+        "-> breaker half-open probe of p1 -> breaker closed on p1")
+    assert chains[1]["sessions"] == {"t"}
+    assert "rejected expired deadline" in chains[1]["chain"]
+    assert "deadline expired client-side" in chains[1]["chain"]
+
+
 def test_replay_costs_sum_per_session():
     tl = [
         _mk("replay_done", 1.0, session="a", fields={"tokens": 100}),
