@@ -862,7 +862,7 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
             "(stage 0 runs inside the client)")
     spec = plan.stages[args.stage]
 
-    registry = RemoteRegistry(args.registry_addr)
+    registry = RemoteRegistry(args.registry_addr, peers_cache=args.peers_cache)
     peer_id = args.peer_id or f"stage{args.stage}-{os.getpid()}"
     if args.sp_zigzag and args.sp <= 1:
         raise SystemExit("--sp_zigzag requires --sp N > 1 (it is a layout "
@@ -945,21 +945,45 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
     # are how its round window coalesces); the sp adapter serializes itself
     # with its own lock (one session owns the mesh anyway).
     runtime = None if (args.batched or args.sp > 1) else StageRuntime()
+    # Decentralized control plane: every serve process embeds a gossip
+    # mirror of the placement records, so the swarm survives losing EVERY
+    # dedicated registry (seeds become bootstrap-only, like DHT initial
+    # peers). The server answers register/heartbeat/list itself and runs
+    # anti-entropy exchanges piggybacked on the heartbeat cadence.
+    from .scheduling.gossip import GossipLoop, GossipNode
+    from .scheduling.registry import rec_to_dict as _r2d
+
+    gnode = GossipNode(peer_id, ttl=registry.ttl,
+                       rng=random.Random(args.seed + os.getpid()))
     srv = TcpStageServer(ex, host=args.host, port=args.rpc_port,
                          wire_dtype=args.wire_dtype, model=_model_id(args),
                          runtime=runtime,
-                         allow_fault_injection=args.allow_fault_injection)
+                         allow_fault_injection=args.allow_fault_injection,
+                         gossip=gnode)
     srv.start()
     # --public_ip overrides the advertised address (the reference's
     # public-maddr-only advertising, component 21 / src/main.py:492-509).
     advert = (f"{args.public_ip}:{srv.address.rsplit(':', 1)[1]}"
               if args.public_ip else srv.address)
+    gnode.self_address = advert
     rec = make_server_record(ex.peer_id, spec,
                              model=_model_id(args),
                              engine=getattr(ex, "engine", "session"))
     rec.max_context = getattr(ex, "max_context", None)
     rec.address = advert
     registry.register(rec)
+    gnode.publish(_r2d(rec))
+
+    from .runtime.net import gossip_exchange as _gx
+
+    def _seed_peers():
+        # Seed the gossip peer set from whatever discovery still works —
+        # the seed registry while it's up, the mirror/stale snapshot after.
+        return [r.address for r in registry.live_servers() if r.address]
+
+    gloop = GossipLoop(gnode, _gx, record_fn=lambda: _r2d(rec),
+                       extra_peers_fn=_seed_peers)
+    gloop.start()
     _emit(f"SERVING stage={args.stage} span=[{spec.start},{spec.end}) "
           f"addr={advert} peer={ex.peer_id}", flush=True)
     # Next-hop RTT probe (petals/server/server.py:760-767): a TcpTransport
@@ -995,10 +1019,12 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        gloop.stop()
         try:
             registry.unregister(ex.peer_id)
         except Exception:
             pass
+        gnode.apply_unregister(ex.peer_id)
         srv.stop()
     return 0
 
@@ -1015,19 +1041,24 @@ def _run_serve_elastic(args, cfg: ModelConfig, params) -> int:
     from .runtime.server import ElasticStageServer
 
     peer = args.peer_id or f"lb-{os.getpid()}"
-    registry = RemoteRegistry(args.registry_addr)
+    registry = RemoteRegistry(args.registry_addr, peers_cache=args.peers_cache)
     # Serialize compute through the prioritized runtime: elastic servers see
     # whatever concurrency the swarm sends them, and concurrent per-session
     # forwards on one executor are not a supported dispatch pattern.
     from .runtime.task_pool import StageRuntime
+    from .scheduling.gossip import GossipLoop, GossipNode
 
+    gnode = GossipNode(peer, ttl=registry.ttl,
+                       rng=random.Random(args.seed + os.getpid()))
     srv = TcpStageServer(None, host=args.host, port=args.rpc_port,
                          wire_dtype=args.wire_dtype, peer_id=peer,
                          model=_model_id(args), runtime=StageRuntime(),
-                         allow_fault_injection=args.allow_fault_injection)
+                         allow_fault_injection=args.allow_fault_injection,
+                         gossip=gnode)
     srv.start()
     advert = (f"{args.public_ip}:{srv.address.rsplit(':', 1)[1]}"
               if args.public_ip else srv.address)
+    gnode.self_address = advert
 
     class _Membership:
         """LocalTransport's membership surface, backed by the live TCP
@@ -1079,13 +1110,28 @@ def _run_serve_elastic(args, cfg: ModelConfig, params) -> int:
     es.start()
     _emit(f"SERVING elastic span=[{es.spec.start},{es.spec.end}) "
           f"addr={advert} peer={peer}", flush=True)
+
+    from .runtime.net import gossip_exchange as _gx
+    from .scheduling.registry import rec_to_dict as _r2d
+
+    def _own_record():
+        # During a re-span the spec is momentarily unset; skip that beat.
+        return _r2d(es._record()) if es.spec is not None else None
+
+    gloop = GossipLoop(
+        gnode, _gx, record_fn=_own_record,
+        extra_peers_fn=lambda: [r.address for r in registry.live_servers()
+                                if r.address])
+    gloop.start()
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         pass
     finally:
+        gloop.stop()
         es.stop()
+        gnode.apply_unregister(peer)
         srv.stop()
     return 0
 
@@ -1097,7 +1143,7 @@ def run_client(args, cfg: ModelConfig, params) -> int:
     splits = parse_splits(args.splits) if args.splits else None
     plan = (StagePlan.from_splits(cfg.num_layers, splits) if splits
             else StagePlan.even(cfg.num_layers, 4))
-    registry = RemoteRegistry(args.registry_addr)
+    registry = RemoteRegistry(args.registry_addr, peers_cache=args.peers_cache)
     transport = TcpTransport(registry, wire_dtype=args.wire_dtype,
                              model=_model_id(args))
     stage0 = _SE(cfg, plan.stages[0],
@@ -1327,6 +1373,235 @@ def chaos_soak(cfg, params, *, prompt_ids, max_new_tokens=10, seed=0,
     return result
 
 
+def registry_loss_soak(cfg, params, *, prompt_ids, max_new_tokens=8, seed=0,
+                       splits=None, wire_dtype="f32", request_timeout=30.0,
+                       peers_cache=None, gossip_interval_s=0.25,
+                       sampling=None, stage_params=None) -> dict:
+    """Total-registry-loss survival drill (the tentpole's acceptance
+    scenario): boot a primary+standby registry and a gossiping stage swarm
+    in-process, kill BOTH registries deterministically mid-generation, and
+    require
+
+      * the in-flight generation to finish with tokens IDENTICAL to a
+        clean run (the data plane never depended on the seeds);
+      * a FRESH client — empty snapshot, seeds dead — to bootstrap through
+        a live stage server's gossip mirror (via the --peers_cache file)
+        and generate the same tokens;
+      * a restarted seed to be re-adopted (``registry_recovered``), and the
+        doctor to reconstruct the whole outage as one failure chain:
+        registries lost -> gossip-served discovery -> seeds restored.
+    """
+    import tempfile as _tempfile
+
+    from .runtime.executor import StageExecutor as _SE
+    from .runtime.net import (RegistryServer, RemoteRegistry, TcpStageServer,
+                              TcpTransport, gossip_exchange)
+    from .runtime.task_pool import StageRuntime
+    from .scheduling.gossip import GossipLoop, GossipNode
+    from .scheduling.registry import rec_to_dict as _r2d
+    from .telemetry import doctor as _doc
+    from .telemetry import events as _events
+
+    _events.get_recorder().enable()
+    if sampling is None:
+        sampling = SamplingParams(temperature=0.0)
+    if stage_params is None:
+        stage_params = lambda spec: slice_stage_params(cfg, params, spec)  # noqa: E731
+    plan = (StagePlan.from_splits(cfg.num_layers, splits) if splits
+            else StagePlan.even(cfg.num_layers, 4))
+    if peers_cache is None:
+        fd, peers_cache = _tempfile.mkstemp(prefix="peers_cache_",
+                                            suffix=".json")
+        os.close(fd)
+
+    problems: List[str] = []
+    result: dict = {"seed": seed, "peers_cache": peers_cache}
+    registries: List[RegistryServer] = []
+    servers: List[TcpStageServer] = []
+    loops: List[GossipLoop] = []
+    transports: List[TcpTransport] = []
+    try:
+        # --- seeds: a primary + one standby, both about to die ---
+        for _ in range(2):
+            rs = RegistryServer(host="127.0.0.1", port=0)
+            rs.start()
+            registries.append(rs)
+        seed_addrs = ",".join(rs.address for rs in registries)
+        reg = RemoteRegistry(seed_addrs, timeout=2.0,
+                             peers_cache=peers_cache)
+
+        # --- gossiping stage swarm (every server embeds a mirror) ---
+        gnodes: List[GossipNode] = []
+        own_recs: List = []
+        for spec in plan.stages[1:]:
+            ex = _SE(cfg, spec, stage_params(spec),
+                     peer_id=f"rloss-s{spec.index}")
+            gnode = GossipNode(ex.peer_id,
+                               rng=random.Random(seed + spec.index))
+            srv = TcpStageServer(ex, host="127.0.0.1", port=0,
+                                 wire_dtype=wire_dtype,
+                                 runtime=StageRuntime(), gossip=gnode)
+            srv.start()
+            gnode.self_address = srv.address
+            rec = make_server_record(ex.peer_id, spec)
+            rec.address = srv.address
+            reg.register(rec)
+            gnode.publish(_r2d(rec))
+            servers.append(srv)
+            gnodes.append(gnode)
+            own_recs.append(rec)
+        all_addrs = [s.address for s in servers]
+        for gnode, rec in zip(gnodes, own_recs):
+            loop = GossipLoop(gnode, gossip_exchange,
+                              record_fn=lambda r=rec: _r2d(r),
+                              extra_peers_fn=lambda: list(all_addrs),
+                              interval_s=gossip_interval_s)
+            loop.start()
+            loops.append(loop)
+        # Anti-entropy must have replicated the FULL live set everywhere
+        # before the seeds die, or a mirror could serve a partial swarm.
+        deadline = time.monotonic() + 30.0
+        want = len(servers)
+        while (any(n.live_count() < want for n in gnodes)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        if any(n.live_count() < want for n in gnodes):
+            problems.append(
+                "gossip never converged: mirror live counts "
+                f"{[n.live_count() for n in gnodes]} < {want}")
+
+        ex0 = _SE(cfg, plan.stages[0], stage_params(plan.stages[0]),
+                  peer_id="rloss-client")
+
+        def _client(tx, stage0, registry):
+            return PipelineClient(cfg, plan, stage0, tx, registry,
+                                  request_timeout=request_timeout,
+                                  settle_seconds=0.0, seed=seed)
+
+        # --- clean reference run (also warms the peers cache) ---
+        tx1 = TcpTransport(reg, wire_dtype=wire_dtype)
+        transports.append(tx1)
+        clean = _client(tx1, ex0, reg).generate(
+            list(prompt_ids), max_new_tokens, sampling=sampling,
+            session_id="rloss-clean")
+        result["tokens_clean"] = list(clean.tokens)
+
+        # --- chaos run: the 2nd stage-0 forward kills EVERY seed ---
+        class _KillSwitch:
+            """Stage-0 proxy that trips `kill` after the Nth forward: the
+            registry massacre lands DETERMINISTICALLY mid-generation
+            (after prefill, before the decode steps finish)."""
+
+            def __init__(self, inner, after_n, kill):
+                self._inner, self._after, self._kill = inner, after_n, kill
+                self.calls = 0
+
+            def forward(self, req):
+                out = self._inner.forward(req)
+                self.calls += 1
+                if self.calls == self._after:
+                    self._kill()
+                return out
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        def _kill_seeds():
+            for rs in registries:
+                try:
+                    rs.stop()
+                except Exception:
+                    pass
+
+        tx2 = TcpTransport(reg, wire_dtype=wire_dtype)
+        transports.append(tx2)
+        chaos = _client(tx2, _KillSwitch(ex0, 2, _kill_seeds), reg).generate(
+            list(prompt_ids), max_new_tokens, sampling=sampling,
+            session_id="rloss-chaos")
+        result["tokens_chaos"] = list(chaos.tokens)
+        if list(clean.tokens) != list(chaos.tokens):
+            problems.append(
+                "token divergence across the registry massacre: "
+                f"clean={list(clean.tokens)} chaos={list(chaos.tokens)}")
+
+        # --- the WARM client's next read must be mirror-served ---
+        recs = reg.live_servers()
+        if len(recs) < want:
+            problems.append(
+                f"warm client saw {len(recs)}/{want} servers after seed "
+                "loss (gossip fallback should have served the full set)")
+
+        # --- fresh client: no snapshot, dead seeds, only the cache file ---
+        reg2 = RemoteRegistry(seed_addrs, timeout=2.0,
+                              peers_cache=peers_cache)
+        boot = reg2.live_servers()
+        result["bootstrap_records"] = len(boot)
+        if len(boot) < want:
+            problems.append(
+                f"fresh client bootstrapped {len(boot)}/{want} records "
+                "from the gossip mirrors")
+        tx3 = TcpTransport(reg2, wire_dtype=wire_dtype)
+        transports.append(tx3)
+        fresh = _client(tx3, ex0, reg2).generate(
+            list(prompt_ids), max_new_tokens, sampling=sampling,
+            session_id="rloss-bootstrap")
+        result["tokens_bootstrap"] = list(fresh.tokens)
+        if list(clean.tokens) != list(fresh.tokens):
+            problems.append(
+                "registry-less bootstrap diverged: "
+                f"clean={list(clean.tokens)} fresh={list(fresh.tokens)}")
+
+        # --- restore a seed: the swarm must re-adopt it ---
+        primary_port = int(registries[0].address.rsplit(":", 1)[1])
+        restored = RegistryServer(host="127.0.0.1", port=primary_port)
+        restored.start()
+        registries.append(restored)
+        for rec in own_recs:
+            reg2.register(rec)      # the serve heartbeat loop's re-register
+        back = reg2.live_servers()
+        if len(back) < want:
+            problems.append(
+                f"restored seed served {len(back)}/{want} records")
+
+        # --- doctor: the outage must read as ONE failure chain ---
+        streams = [{"meta": {"pid": os.getpid()},
+                    "events": [ev.to_dict()
+                               for ev in _events.get_recorder().events()]}]
+        chains = _doc.failure_chains(_doc.merge_timeline(streams))
+        result["chains"] = len(chains)
+        ok_chain = False
+        for ch in chains:
+            names = {ev.get("event") for ev in ch["events"]}
+            if ("registry_unreachable" in names
+                    and ({"gossip_fallback", "gossip_served_discovery"}
+                         & names)
+                    and "registry_recovered" in names):
+                ok_chain = True
+        if not ok_chain:
+            problems.append(
+                "doctor chains do not reconstruct the outage (want one "
+                "chain with registry_unreachable + gossip-served "
+                "discovery + registry_recovered)")
+    finally:
+        for loop in loops:
+            loop.stop()
+        for tx in transports:
+            try:
+                tx.close()
+            except Exception:
+                pass
+        for srv in servers:
+            srv.stop()
+        for rs in registries:
+            try:
+                rs.stop()
+            except Exception:
+                pass
+    result["problems"] = problems
+    result["ok"] = not problems
+    return result
+
+
 def run_chaos(args, cfg: ModelConfig, params) -> int:
     from . import telemetry
 
@@ -1336,6 +1611,32 @@ def run_chaos(args, cfg: ModelConfig, params) -> int:
                                else args.checkpoint)
     prompt_ids = [i % cfg.vocab_size for i in tokenizer.encode(args.prompt)]
     splits = parse_splits(args.splits) if args.splits else None
+    if args.chaos_scenario == "registry_loss":
+        if args.chaos_attach:
+            _emit("CHAOS SOAK FAIL: --chaos_scenario registry_loss boots "
+                  "its own swarm (it must own the seeds it kills); drop "
+                  "--chaos_attach")
+            return 1
+        res = registry_loss_soak(
+            cfg, params, prompt_ids=prompt_ids,
+            max_new_tokens=args.max_new_tokens, seed=args.seed,
+            splits=splits, wire_dtype=args.wire_dtype,
+            request_timeout=args.request_timeout,
+            peers_cache=args.peers_cache)
+        _emit(f"\n=== Registry-loss soak (seed={res['seed']}) ===")
+        _emit(f"tokens (clean)     : {res.get('tokens_clean')}")
+        _emit(f"tokens (chaos)     : {res.get('tokens_chaos')}")
+        _emit(f"tokens (bootstrap) : {res.get('tokens_bootstrap')}")
+        _emit(f"bootstrap records  : {res.get('bootstrap_records')}")
+        _emit(f"failure chains     : {res.get('chains', 0)}")
+        if res["ok"]:
+            _emit("REGISTRY-LOSS SOAK PASS: identical tokens across total "
+                  "seed loss; fresh client bootstrapped via gossip; doctor "
+                  "reconstructed the outage")
+            return 0
+        for p in res["problems"]:
+            _emit(f"REGISTRY-LOSS SOAK FAIL: {p}")
+        return 1
     res = chaos_soak(
         cfg, params, prompt_ids=prompt_ids,
         max_new_tokens=args.max_new_tokens, seed=args.seed, splits=splits,
@@ -1525,6 +1826,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "outage serves cached records under TTL grace")
     p.add_argument("--registry_port", type=int, default=31330,
                    help="registry mode: listen port (the --dht_port role)")
+    p.add_argument("--peers_cache", default=None, metavar="PATH",
+                   help="serve/client: persist the last-known live server "
+                        "addresses to PATH (JSON) after every successful "
+                        "registry read, and load them at startup as "
+                        "any-peer bootstrap candidates — a fresh process "
+                        "can then join the swarm through a live stage "
+                        "server's gossip mirror even when EVERY "
+                        "--registry_addr seed is down")
     p.add_argument("--rpc_port", type=int, default=0,
                    help="serve mode: data-plane port (0 = ephemeral)")
     p.add_argument("--host", default="127.0.0.1")
@@ -1540,6 +1849,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "this process (registry and serve roles). NEVER set "
                         "on a production swarm — it lets any client that "
                         "can dial the port inject faults")
+    p.add_argument("--chaos_scenario", choices=["faults", "registry_loss"],
+                   default="faults",
+                   help="chaos mode: 'faults' runs the seeded fault-"
+                        "injection soak; 'registry_loss' kills the primary "
+                        "AND every standby registry mid-generation and "
+                        "requires identical tokens plus a gossip-served "
+                        "fresh-client bootstrap (in-process swarm only)")
     p.add_argument("--chaos_attach", action="store_true",
                    help="chaos mode: instead of booting an in-process "
                         "swarm, attach to the externally launched one at "
@@ -1658,7 +1974,7 @@ def run_metrics(args) -> int:
     from .runtime.net import RemoteRegistry, TcpTransport
     from .scheduling.registry import PlacementRegistry as _PR
 
-    registry = RemoteRegistry(args.registry_addr)
+    registry = RemoteRegistry(args.registry_addr, peers_cache=args.peers_cache)
     records = registry.live_servers(model=args.model_name)
     if not records:
         _emit("no live servers")
@@ -1708,13 +2024,26 @@ def run_status(args) -> int:
     from .runtime.net import RemoteRegistry, TcpTransport
     from .scheduling.registry import PlacementRegistry as _PR
 
-    registry = RemoteRegistry(args.registry_addr)
+    registry = RemoteRegistry(args.registry_addr, peers_cache=args.peers_cache)
     # ONE registry snapshot: records, coverage, and info-probe addressing all
     # derive from it, so the report describes a single swarm state (and the
     # registry sees one list RPC, not N+2).
     # Status shows the WHOLE swarm by default; an explicit --model_name scopes
     # the report (and its health verdict) to that model's records.
     records = registry.live_servers(model=args.model_name)
+    # Control-plane degradation banner: the report below may describe a
+    # mirror- or cache-served swarm view — an operator must never mistake
+    # that for "seeds healthy".
+    st = registry.stale_info()
+    if st["seeds_down"]:
+        line = (f"registry seeds DOWN for {st['seeds_down_s']:.1f}s "
+                f"(every --registry_addr address unreachable)")
+        if st["stale"]:
+            line += (f"; serving STALE cached records for "
+                     f"{st['stale_s']:.1f}s (TTL grace)")
+        else:
+            line += "; records served via a stage server's gossip mirror"
+        _emit(line)
     if not records:
         _emit("no live servers")
         return 1
@@ -1813,7 +2142,7 @@ def run_doctor(args) -> int:
     from .runtime.net import RemoteRegistry, TcpTransport
     from .scheduling.registry import PlacementRegistry as _PR
 
-    registry = RemoteRegistry(args.registry_addr)
+    registry = RemoteRegistry(args.registry_addr, peers_cache=args.peers_cache)
     records = registry.live_servers(model=args.model_name)
     if not records:
         _emit("no live servers and no --dumps given")

@@ -304,6 +304,7 @@ class PipelineClient:
         model: Optional[str] = None,
         long_context_threshold: Optional[int] = None,
         metrics: Optional[MetricsRegistry] = None,
+        route_cache_capacity: int = 64,
     ):
         self.cfg = cfg
         # Multi-model swarm: every discovery/coverage query is scoped to this
@@ -360,7 +361,11 @@ class PipelineClient:
         #   "exotic" — beam / training / anything the single-session
         #              engines refuse (batching.py forward checks) routes
         #              around them.
-        # Keyed so kinds never evict each other's route.
+        # Keyed so kinds never evict each other's route. Capacity bounds the
+        # affinity-keyed entries (one per distinct prompt-head digest —
+        # unbounded in a long-lived client); swarm-scale tuning is a
+        # constructor knob, evictions are counted.
+        self.route_cache_capacity = int(route_cache_capacity)
         self._routes: Dict[str, List[Hop]] = {}
         # peer -> (rtt_s, measured_at): client-side ping cache for the
         # latency planner's first hop. Route recomputation runs on the
@@ -393,6 +398,8 @@ class PipelineClient:
         self._m_route_hops = _tm.get("scheduler_route_hops")
         self._m_deadline = _tm.get("client_deadline_expired_total",
                                    self.metrics)
+        self._m_route_evictions = _tm.get(
+            "client_route_cache_evictions_total", self.metrics)
         # Per-peer circuit breaker: bounds how often the recovery loop
         # re-dials a flapping peer (consecutive-failure threshold -> open
         # with exponential backoff + jitter -> half-open single probe ->
@@ -603,7 +610,7 @@ class PipelineClient:
             affinity = None
         key = (kind, min_context, affinity)
         if refresh or key not in self._routes:
-            while len(self._routes) >= 64:
+            while len(self._routes) >= self.route_cache_capacity:
                 # Evict LRU among AFFINITY-CARRYING keys only. The
                 # affinity=None entries are the per-(kind, min_context)
                 # fallback routes — a bounded handful that every
@@ -616,6 +623,7 @@ class PipelineClient:
                 if victim is None:
                     break  # all entries are exempt fallback routes
                 self._routes.pop(victim)
+                self._m_route_evictions.inc()
             self._routes[key] = self._compute_route(kind, min_context,
                                                     affinity)
         else:

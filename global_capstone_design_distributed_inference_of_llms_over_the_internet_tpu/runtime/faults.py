@@ -44,6 +44,16 @@ Fault kinds (`FaultRule.kind`):
                           by ``age_s`` (`PlacementRegistry.age_records`)
                           before answering — models a partitioned /
                           lagging control plane.
+  ``gossip_drop``         a stage server's gossip dispatch swallows the
+                          anti-entropy frame (no merge, no reply) — the
+                          initiator's round dies and convergence must
+                          ride a later round with another peer.
+                          ``duplicate`` also arms at the gossip site (the
+                          delta merged twice proves merge idempotency on
+                          the wire); delaying/hanging a gossip frame needs
+                          no new kind — a ``delay``/``accept_hang`` rule
+                          with ``verb="gossip"`` rides the generic
+                          dispatch hook.
 
 Determinism: matching is pure counting (per-rule ``nth``/``every``/
 ``times``) plus an RNG seeded at plan construction for ``prob`` rules and
@@ -81,6 +91,7 @@ KINDS = (
     "delay",
     "duplicate",
     "stale_registry",
+    "gossip_drop",
 )
 
 # Which sites can act on which kinds (documentation + validation; the call
@@ -94,6 +105,13 @@ SITE_KINDS = {
              "delay"),
     "dispatch": ("accept_hang", "delay"),
     "registry": ("duplicate", "stale_registry"),
+    # The gossip seam sits INSIDE a stage server's dispatch, after the
+    # generic dispatch hooks (which already give gossip-verb rules
+    # accept_hang/delay — a stalled or swallowed-with-hang exchange), and
+    # consults only gossip-frame traffic: drop kills the exchange,
+    # duplicate merges the delta twice (anti-entropy merge is idempotent;
+    # this proves it on the wire).
+    "gossip": ("gossip_drop", "duplicate"),
 }
 
 SIDES = ("client", "server", "registry")

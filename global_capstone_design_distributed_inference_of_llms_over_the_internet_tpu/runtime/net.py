@@ -46,7 +46,13 @@ import numpy as np
 
 from .. import native
 from ..ops.sampling import SamplingParams
-from ..scheduling.registry import PlacementRegistry, ServerRecord
+from ..scheduling.gossip import GossipNode
+from ..scheduling.registry import (
+    PlacementRegistry,
+    ServerRecord,
+    dict_to_rec,
+    rec_to_dict,
+)
 from ..telemetry import catalog as _tm
 from ..telemetry import events as _ev
 from ..telemetry import exposition as _texp
@@ -566,11 +572,18 @@ class TcpStageServer(_FramedTcpServer):
                  owns_runtime: bool = True,
                  peer_id: Optional[str] = None,
                  model: Optional[str] = None,
-                 allow_fault_injection: bool = False):
+                 allow_fault_injection: bool = False,
+                 gossip: Optional[GossipNode] = None):
         # May be swapped at runtime (elastic servers re-span in place) or
         # None during a re-span window — requests then get a retryable
         # stage error and clients fail over / retry.
         self.executor = executor
+        # Decentralized control plane: when a GossipNode is attached this
+        # server also answers the registry service's verbs from its mirror
+        # (any-peer bootstrap) and the `gossip` anti-entropy verb — see
+        # _gossip_dispatch. None (the default) keeps the server data-plane
+        # only, exactly the pre-gossip behavior.
+        self.gossip = gossip
         # Stable identity independent of the (swappable) executor: error
         # frames must carry a real peer id even mid-re-span, or push-chain
         # clients blacklist a placeholder and never route around us.
@@ -712,6 +725,66 @@ class TcpStageServer(_FramedTcpServer):
             except OSError:
                 pass
 
+    def _gossip_dispatch(self, sock, header: dict) -> None:
+        """Serve the decentralized control plane from this server's
+        GossipNode: the `gossip` anti-entropy verb, plus the registry
+        service's register/heartbeat/unregister/list with RegistryServer's
+        exact response shapes — `RemoteRegistry` pointed at THIS address
+        works unmodified (any-peer bootstrap)."""
+        node = self.gossip
+        verb = header.get("verb")
+        if verb == "gossip":
+            plan = self.fault_plan
+            if plan is not None:
+                rule = plan.fire("gossip", SITE_KINDS["gossip"],
+                                 side=self.fault_side,
+                                 peer=header.get("peer_id"), verb=verb)
+                if rule is not None:
+                    if rule.kind == "gossip_drop":
+                        # Swallow the frame: the initiator's round dies
+                        # (read timeout) and anti-entropy rides a later
+                        # round — which the soak proves still converges.
+                        return
+                    # duplicate: merge the delta twice — idempotent.
+                    node.merge(header.get("entries") or ())
+            merged = node.merge(header.get("entries") or ())
+            resp = {"verb": "gossip", "peer_id": self.peer_id,
+                    "merged": merged}
+            digest = header.get("digest")
+            if digest is not None:
+                # Round opener: answer with OUR digest and the entries the
+                # initiator's digest shows it lacks (digest-then-delta).
+                resp["digest"] = node.digest()
+                resp["entries"] = node.delta_for(digest)
+                _tm.get("gossip_rounds_total").labels(role="responder").inc()
+            _send_frame(sock, resp)
+            return
+        _tm.get("gossip_mirror_requests_total").labels(verb=verb).inc()
+        if verb == "register":
+            node.publish(dict(header["record"]))
+            _send_frame(sock, {"verb": "ok", "ttl": node.ttl})
+            return
+        if verb == "heartbeat":
+            ok = node.apply_heartbeat(
+                header["peer_id"], throughput=header.get("throughput"),
+                cache_tokens_left=header.get("cache_tokens_left"),
+                next_server_rtts=header.get("next_server_rtts"))
+            _send_frame(sock, {"verb": "ok", "known": ok, "ttl": node.ttl})
+            return
+        if verb == "unregister":
+            node.apply_unregister(header["peer_id"])
+            _send_frame(sock, {"verb": "ok"})
+            return
+        # list — a client discovering through us instead of a seed.
+        now = time.monotonic()
+        records = [dict(_rec_to_dict(r),
+                        age_s=max(0.0, now - r.timestamp))
+                   for r in node.live_servers()]
+        _ev.emit("gossip_served_discovery", peer=self.peer_id,
+                 records=len(records))
+        _send_frame(sock, {"verb": "records", "ttl": node.ttl,
+                           "records": records})
+
     def _dispatch(self, sock, header: dict, payload: bytes) -> None:
         verb = header.get("verb")
         if verb == "reach_check":
@@ -745,6 +818,14 @@ class TcpStageServer(_FramedTcpServer):
             # server's FaultPlan. Executor-less (a re-spanning server still
             # takes plans) and gated by allow_fault_injection.
             _send_frame(sock, self._fault_admin(header))
+            return
+        if self.gossip is not None and verb in (
+                "gossip", "register", "heartbeat", "unregister", "list"):
+            # Control-plane mirror: executor-less on purpose — a
+            # re-spanning server must keep gossiping and keep serving
+            # discovery, or the control plane would flap exactly when the
+            # swarm is reorganizing.
+            self._gossip_dispatch(sock, header)
             return
         # Snapshot: the elastic rebalance thread may null/swap self.executor
         # at any moment; every later access in this request must see ONE
@@ -1868,20 +1949,52 @@ def check_direct_reachability(transport: TcpTransport, registry,
 # Registry service (control plane)
 # ---------------------------------------------------------------------------
 
-_REC_FIELDS = ("peer_id", "start_block", "end_block", "throughput", "state",
-               "final_stage", "stage_index", "cache_tokens_left", "address",
-               "next_server_rtts", "model", "engine", "max_context")
+# Record wire schema now lives beside the dataclass (scheduling.registry) so
+# the gossip mirrors serialize identically; these aliases keep this module's
+# historical private names working.
+_rec_to_dict = rec_to_dict
+_dict_to_rec = dict_to_rec
 
 
-def _rec_to_dict(rec: ServerRecord) -> dict:
-    return {f: getattr(rec, f) for f in _REC_FIELDS}
+def gossip_exchange(node: GossipNode, address: str,
+                    timeout: float = 5.0) -> Tuple[int, int]:
+    """One digest-then-delta anti-entropy round with the stage server at
+    `address` (initiator side; the responder is `_gossip_dispatch`).
 
+      1. ship our digest; the peer answers with ITS digest plus the
+         entries our digest shows we lack — merge them;
+      2. ship back the entries the peer's digest shows IT lacks (skipped
+         when it already has everything).
 
-def _dict_to_rec(d: dict) -> ServerRecord:
-    vals = {f: d.get(f) for f in _REC_FIELDS}
-    if vals.get("engine") is None:      # record from a pre-engine peer
-        vals["engine"] = "session"
-    return ServerRecord(**vals)
+    Returns (entries_sent, entries_merged). Connection errors propagate —
+    the gossip loop treats a dead peer as this round's loss, nothing more.
+    """
+    host, port = address.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)), timeout=timeout)
+    try:
+        sock.settimeout(timeout)
+        _send_frame(sock, {"verb": "gossip", "peer_id": node.peer_id,
+                           "digest": node.digest()})
+        resp, _ = _recv_frame(sock)
+        if resp.get("verb") != "gossip":
+            raise ConnectionError(
+                f"peer at {address} does not gossip: "
+                f"{resp.get('message', resp.get('verb'))!r}")
+        merged = node.merge(resp.get("entries") or ())
+        delta = node.delta_for(resp.get("digest") or {})
+        if delta:
+            _send_frame(sock, {"verb": "gossip", "peer_id": node.peer_id,
+                               "entries": delta})
+            _recv_frame(sock)      # ack ({"verb": "gossip", "merged": n})
+        _tm.get("gossip_rounds_total").labels(role="initiator").inc()
+        _ev.emit("gossip_round", peer=address, sent=len(delta),
+                 merged=merged)
+        return len(delta), merged
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
 
 
 class RegistryServer(_FramedTcpServer):
@@ -1973,7 +2086,8 @@ class RemoteRegistry:
     """
 
     def __init__(self, address: str, timeout: float = 5.0,
-                 rng: Optional["np.random.Generator"] = None):
+                 rng: Optional["np.random.Generator"] = None,
+                 peers_cache: Optional[str] = None):
         self._addrs = []
         for part in str(address).split(","):
             part = part.strip()
@@ -1999,7 +2113,18 @@ class RemoteRegistry:
         self._local = PlacementRegistry(rng=_random.Random(0))
         self._have_snapshot = False
         self._stale_since: Optional[float] = None
+        self._seeds_down_since: Optional[float] = None
         self.ttl = self._local.ttl
+        # Last-known-peers bootstrap cache (--peers_cache): stage-server
+        # addresses from the last good snapshot, persisted to disk so a
+        # FRESHLY STARTED client (no snapshot yet) can still bootstrap off
+        # a live stage server's gossip mirror after total seed loss.
+        self.peers_cache = peers_cache
+        self._cached_peer_addrs: List[str] = self._load_peers_cache()
+        # Buffered registrations (one per peer): a register issued while
+        # every registry is down must not be silently dropped — it flushes
+        # on the first successful reconnect (see _rpc_one_locked).
+        self._pending_register: Dict[str, dict] = {}
 
     def _rpc_one_locked(self, i: int, header: dict) -> dict:
         """One request/response against registry i (caller holds the lock).
@@ -2015,6 +2140,8 @@ class RemoteRegistry:
                 _send_frame(self._socks[i], header)
                 resp, _ = _recv_frame(self._socks[i])
                 self._down_until[i] = 0.0
+                if self._pending_register and header.get("verb") != "register":
+                    self._flush_pending_locked(i)
                 return resp
             except (ConnectionError, OSError):
                 if self._socks[i] is not None:
@@ -2026,6 +2153,23 @@ class RemoteRegistry:
                     self._down_until[i] = time.monotonic() + self.down_backoff_s
                     raise
         raise AssertionError("unreachable")
+
+    def _flush_pending_locked(self, i: int) -> None:
+        """Replay buffered registrations into registry `i` (just proven
+        reachable; caller holds the lock and the live socket). A failure
+        mid-flush leaves the remainder buffered for the next success."""
+        for peer in list(self._pending_register):
+            rec = self._pending_register[peer]
+            try:
+                _send_frame(self._socks[i], {"verb": "register",
+                                             "record": rec})
+                resp, _ = _recv_frame(self._socks[i])
+            except (ConnectionError, OSError):
+                return
+            self._pending_register.pop(peer, None)
+            self._sync_ttl(resp)
+            logger.info("flushed buffered registration of %s to %s:%d",
+                        peer, *self._addrs[i])
 
     def _up_order(self, start: int = 0) -> List[int]:
         """Registry indices, not-in-backoff first (rotated from `start`),
@@ -2086,8 +2230,24 @@ class RemoteRegistry:
 
     def register(self, record: ServerRecord, ttl: Optional[float] = None) -> None:
         del ttl  # server-side TTL policy
-        for resp in self._rpc_all(
-                {"verb": "register", "record": _rec_to_dict(record)}):
+        rec = _rec_to_dict(record)
+        try:
+            resps = self._rpc_all({"verb": "register", "record": rec})
+        except (ConnectionError, OSError):
+            # Every registry is down: buffer the LAST record per peer and
+            # flush on the first successful reconnect — without this, a
+            # registration issued during an outage silently vanished until
+            # the heartbeat loop's known=false repair, and a peer that
+            # never heartbeats (a client-issued set_state) stayed lost.
+            with self._lock:
+                self._pending_register[record.peer_id] = rec
+            logger.warning(
+                "register(%s): every registry unreachable; buffered for "
+                "flush on reconnect", record.peer_id)
+            return
+        with self._lock:
+            self._pending_register.pop(record.peer_id, None)
+        for resp in resps:
             self._sync_ttl(resp)
 
     def heartbeat(self, peer_id: str, throughput: Optional[float] = None,
@@ -2116,25 +2276,55 @@ class RemoteRegistry:
     # -- read path (local evaluation over fetched records) ------------------
 
     def _refresh(self) -> None:
+        source = "seed"
         try:
             resp = self._rpc({"verb": "list"})
         except (ConnectionError, OSError):
-            if not self._have_snapshot:
-                raise
-            # STALE-CACHE GRACE: every registry is down, but we hold a
-            # previous snapshot whose records age out through the normal
-            # TTL — keep serving it so discovery and pinned-route repair
-            # survive an outage shorter than the TTL.
-            if self._stale_since is None:
-                self._stale_since = time.monotonic()
-                _ev.emit("registry_unreachable",
-                         registries=len(self._addrs))
+            if self._seeds_down_since is None:
+                self._seeds_down_since = time.monotonic()
+                _ev.emit("registry_unreachable", registries=len(self._addrs))
                 logger.warning(
-                    "all %d registr%s unreachable; serving the cached "
-                    "record snapshot under TTL grace",
+                    "all %d registry seed%s unreachable",
                     len(self._addrs),
-                    "y is" if len(self._addrs) == 1 else "ies are")
-            return
+                    " is" if len(self._addrs) == 1 else "s are")
+            # ANY-PEER BOOTSTRAP: every seed registry is down, but the
+            # stage servers gossip the placement records among themselves —
+            # any live one answers `list` from its mirror. Candidates come
+            # from the current snapshot and from the on-disk peers cache
+            # (so even a freshly restarted client survives total seed loss).
+            resp = self._fallback_list()
+            source = "mirror"
+            if resp is None:
+                if not self._have_snapshot:
+                    raise
+                # STALE-CACHE GRACE: every registry AND every known stage
+                # server is unreachable, but we hold a previous snapshot
+                # whose records age out through the normal TTL — keep
+                # serving it so discovery and pinned-route repair survive
+                # an outage shorter than the TTL.
+                _tm.get("client_registry_stale_reads_total").inc()
+                if self._stale_since is None:
+                    self._stale_since = time.monotonic()
+                    _ev.emit("registry_stale_serve",
+                             registries=len(self._addrs))
+                    logger.warning(
+                        "no registry and no live stage server reachable; "
+                        "serving the cached record snapshot under TTL "
+                        "grace")
+                return
+        now = time.monotonic()
+        if source == "seed":
+            if self._seeds_down_since is not None:
+                _ev.emit("registry_recovered", source="seed",
+                         stale_s=round(now - self._seeds_down_since, 3))
+                logger.info("registry seeds reachable again")
+            self._seeds_down_since = None
+        elif self._stale_since is not None:
+            # A mirror answered after a stale-serving window: fresh records
+            # again, though the seeds are still gone (that window stays
+            # open until a seed read succeeds).
+            _ev.emit("registry_recovered", source="mirror",
+                     stale_s=round(now - self._stale_since, 3))
         self._stale_since = None
         self._sync_ttl(resp)
         import random as _random
@@ -2156,6 +2346,97 @@ class RemoteRegistry:
             rec.expires_at = rec.timestamp + fresh.ttl
         self._local = fresh
         self._have_snapshot = True
+        self._save_peers_cache()
+
+    # -- any-peer bootstrap (gossip mirrors + peers cache) -------------------
+
+    def _fallback_list(self) -> Optional[dict]:
+        """`list` served by ANY live stage server's gossip mirror: tried in
+        order over the snapshot's stage addresses then the on-disk peers
+        cache. None when nobody answered (pure pre-gossip outage)."""
+        for addr in self._fallback_candidates():
+            try:
+                host, port = addr.rsplit(":", 1)
+                sock = socket.create_connection((host, int(port)),
+                                                timeout=self.timeout)
+                try:
+                    sock.settimeout(self.timeout)
+                    _send_frame(sock, {"verb": "list"})
+                    resp, _ = _recv_frame(sock)
+                finally:
+                    sock.close()
+            except (ConnectionError, OSError, ValueError):
+                continue
+            if resp.get("verb") != "records":
+                # A stage server without a gossip mirror answers an error
+                # frame — not a discovery source, keep looking.
+                continue
+            _tm.get("client_registry_fallback_reads_total").inc()
+            _ev.emit("gossip_fallback", address=addr,
+                     records=len(resp.get("records") or ()))
+            logger.warning(
+                "registry reads served by stage server %s (gossip mirror)",
+                addr)
+            return resp
+        return None
+
+    def _fallback_candidates(self) -> List[str]:
+        seeds = {"%s:%d" % a for a in self._addrs}
+        seen, out = set(seeds), []
+        for r in self._local.live_servers():
+            a = getattr(r, "address", None)
+            if a and a not in seen:
+                seen.add(a)
+                out.append(a)
+        for a in self._cached_peer_addrs:
+            if a and a not in seen:
+                seen.add(a)
+                out.append(a)
+        return out
+
+    def _load_peers_cache(self) -> List[str]:
+        if not self.peers_cache:
+            return []
+        try:
+            with open(self.peers_cache, "r", encoding="utf-8") as fh:
+                data = json.load(fh)
+            return [str(a) for a in data.get("addresses", [])]
+        except (OSError, ValueError):
+            return []
+
+    def _save_peers_cache(self) -> None:
+        """Persist the snapshot's stage-server addresses (atomic rename) so
+        a fresh client process can bootstrap with every seed dead."""
+        addrs = []
+        for r in self._local.live_servers():
+            a = getattr(r, "address", None)
+            if a and a not in addrs:
+                addrs.append(a)
+        self._cached_peer_addrs = addrs
+        if not self.peers_cache or not addrs:
+            return
+        try:
+            tmp = f"{self.peers_cache}.tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump({"addresses": addrs, "saved_wall": time.time()}, fh)
+            import os
+
+            os.replace(tmp, self.peers_cache)
+        except OSError:
+            logger.debug("could not write peers cache %s", self.peers_cache,
+                         exc_info=True)
+
+    def stale_info(self) -> dict:
+        """The current outage windows, for --mode status and operators:
+        `seeds_down_s` since every seed stopped answering (0 = healthy),
+        `stale_s` since reads fell back to the STALE snapshot (0 = reads
+        are fresh, possibly via a gossip mirror)."""
+        now = time.monotonic()
+        sd, st = self._seeds_down_since, self._stale_since
+        return {"seeds_down": sd is not None,
+                "seeds_down_s": 0.0 if sd is None else now - sd,
+                "stale": st is not None,
+                "stale_s": 0.0 if st is None else now - st}
 
     def live_servers(self, model=None):
         self._refresh()

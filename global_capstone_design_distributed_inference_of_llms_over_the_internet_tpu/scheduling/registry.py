@@ -88,6 +88,29 @@ class ServerRecord:
         return (now or time.monotonic()) >= self.expires_at
 
 
+# Wire schema for ServerRecord: the field set shipped by the registry
+# service's register/list verbs AND by gossip deltas. Owned here (beside the
+# dataclass) so every control-plane surface — runtime.net's RegistryServer,
+# the gossip mirrors, the peers-cache file — serializes identically.
+# `timestamp`/`expires_at` are deliberately absent: they are time.monotonic()
+# values, meaningless across hosts; freshness crosses the wire as RELATIVE
+# age/TTL-remaining and is re-anchored on receipt.
+REC_FIELDS = ("peer_id", "start_block", "end_block", "throughput", "state",
+              "final_stage", "stage_index", "cache_tokens_left", "address",
+              "next_server_rtts", "model", "engine", "max_context")
+
+
+def rec_to_dict(rec: "ServerRecord") -> dict:
+    return {f: getattr(rec, f) for f in REC_FIELDS}
+
+
+def dict_to_rec(d: dict) -> "ServerRecord":
+    vals = {f: d.get(f) for f in REC_FIELDS}
+    if vals.get("engine") is None:      # record from a pre-engine peer
+        vals["engine"] = "session"
+    return ServerRecord(**vals)
+
+
 def _model_ok(rec: ServerRecord, model: Optional[str]) -> bool:
     """Model filter for discovery/coverage queries: a query for model M sees
     M's records plus legacy untagged ones; a query with no model sees all

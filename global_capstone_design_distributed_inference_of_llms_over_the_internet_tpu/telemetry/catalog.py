@@ -127,6 +127,17 @@ SPEC: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Sequence[float]]]] = {
     "client_deadline_expired_total": (
         COUNTER, "Hops abandoned client-side because the end-to-end "
                  "deadline budget ran out.", (), None),
+    "client_registry_stale_reads_total": (
+        COUNTER, "Registry reads served from the client's stale snapshot "
+                 "while every registry address was down (TTL grace).",
+        (), None),
+    "client_registry_fallback_reads_total": (
+        COUNTER, "Registry reads served by a live stage server's gossip "
+                 "mirror after every seed failed (any-peer bootstrap).",
+        (), None),
+    "client_route_cache_evictions_total": (
+        COUNTER, "Route-cache entries evicted because the cache hit its "
+                 "configured capacity.", (), None),
     # -- transport ----------------------------------------------------------
     "transport_calls_total": (
         COUNTER, "Transport round trips, per verb.", ("verb",), None),
@@ -140,6 +151,20 @@ SPEC: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Sequence[float]]]] = {
     "transport_faults_injected_total": (
         COUNTER, "Chaos-layer fault firings, per kind (runtime.faults).",
         ("kind",), None),
+    # -- gossip control plane -----------------------------------------------
+    "gossip_rounds_total": (
+        COUNTER, "Anti-entropy exchanges, per role (initiator|responder).",
+        ("role",), None),
+    "gossip_entries_merged_total": (
+        COUNTER, "Record versions accepted into this process's gossip "
+                 "mirror (newer seq, or a winning tombstone).", (), None),
+    "gossip_mirror_records": (
+        GAUGE, "Live (non-tombstoned, unexpired) records in this "
+               "process's gossip mirror.", (), None),
+    "gossip_mirror_requests_total": (
+        COUNTER, "Registry verbs answered by this stage server's embedded "
+                 "mirror, per verb (register|heartbeat|unregister|list).",
+        ("verb",), None),
     # -- scheduler ----------------------------------------------------------
     "scheduler_route_plans_total": (
         COUNTER, "Route computations, per planner (greedy|latency).",

@@ -36,6 +36,7 @@ _TRIGGERS = {
     "fault_injected": "injected fault",
     "deadline_expired": "deadline expired",
     "deadline_rejected": "deadline rejected",
+    "registry_unreachable": "registries unreachable",
 }
 # Events that CONTINUE a chain once triggered.
 _CHAIN = {
@@ -43,6 +44,10 @@ _CHAIN = {
     "blacklist_amnesty", "rebalance_decision", "rebalance_done",
     "rebalance_failed", "server_rejoin", "kv_eviction",
     "breaker_open", "breaker_half_open", "breaker_close",
+    # Control-plane outage story: registries lost -> stale snapshot /
+    # gossip-served discovery -> seeds restored.
+    "registry_stale_serve", "gossip_fallback", "gossip_served_discovery",
+    "registry_recovered",
 }
 
 # Counter patterns in the embedded Prometheus exposition that should be
@@ -135,6 +140,19 @@ def _describe(ev: dict) -> str:
     if name == "deadline_rejected":
         return (f"{f.get('peer', '?')} rejected expired deadline "
                 f"(budget {f.get('budget_s', '?')}s)")
+    if name == "registry_unreachable":
+        return f"all {f.get('registries', '?')} registries unreachable"
+    if name == "registry_stale_serve":
+        return "discovery serving the stale registry snapshot"
+    if name == "gossip_fallback":
+        return (f"registry reads served by stage mirror "
+                f"{f.get('address', '?')}")
+    if name == "gossip_served_discovery":
+        return (f"mirror on {f.get('peer', '?')} served discovery "
+                f"({f.get('records', '?')} records)")
+    if name == "registry_recovered":
+        return (f"registry recovered after {f.get('stale_s', '?')}s "
+                f"(via {f.get('source', '?')})")
     return str(name)
 
 

@@ -187,6 +187,35 @@ EVENTS: Dict[str, Tuple[str, str, str]] = {
         "registry", WARN,
         "Every registry address was down; serving the cached snapshot "
         "under TTL grace (fields: registries)."),
+    "registry_stale_serve": (
+        "registry", WARN,
+        "Registry reads started being served from the client's stale "
+        "snapshot — the outage window opens here; every read inside it "
+        "counts in client_registry_stale_reads_total (fields: "
+        "registries)."),
+    "registry_recovered": (
+        "registry", INFO,
+        "Fresh registry records arrived after an outage window (fields: "
+        "stale_s, source=seed|mirror)."),
+    # -- gossip control plane ------------------------------------------------
+    "gossip_round": (
+        "gossip", DEBUG,
+        "One anti-entropy exchange with a peer completed (fields: peer, "
+        "sent, merged)."),
+    "gossip_fallback": (
+        "gossip", WARN,
+        "Every registry seed is down; the client's registry reads are "
+        "being served by a live stage server's gossip mirror (fields: "
+        "address, records)."),
+    "gossip_served_discovery": (
+        "gossip", INFO,
+        "A stage server's embedded mirror answered a discovery `list` — "
+        "a client is bootstrapping without any seed registry (fields: "
+        "peer, records)."),
+    "gossip_tombstone": (
+        "gossip", INFO,
+        "An unregister became a grace-period tombstone; older live "
+        "versions cannot resurrect the record (fields: peer, seq)."),
     # -- process ------------------------------------------------------------
     "process_start": (
         "process", INFO,

@@ -14,6 +14,15 @@ Usage (tiny random-weight gpt2 by default)::
 
     python scripts/chaos_swarm.py --model gpt2 --splits 4,8 \
         --prompt "hello" --max_new_tokens 10 --seed 0
+
+``--kill_registries`` runs the total-registry-loss drill instead: a
+primary + standby registry and a gossiping stage swarm come up as real OS
+processes, a client starts generating, and BOTH registries get SIGKILLed
+mid-run. The in-flight client must finish (rc=0), and a SECOND, freshly
+started client — seeds still dead, armed only with the shared
+``--peers_cache`` file — must bootstrap through a stage server's gossip
+mirror and generate too. This is the multi-process twin of the in-process
+``--mode chaos --chaos_scenario registry_loss`` soak.
 """
 
 import argparse
@@ -37,6 +46,120 @@ def registry_list(addr):
     return RemoteRegistry(addr).live_servers()
 
 
+def _teardown(procs):
+    for proc, log in procs:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGINT)
+    for proc, log in procs:
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        log.close()
+
+
+def kill_registries_drill(args, env, spawn, procs, common, log_dir):
+    """Total-registry-loss drill, multi-process edition: SIGKILL every seed
+    under a live client, then bootstrap a brand-new client through a stage
+    server's gossip mirror using only the shared --peers_cache file."""
+    num_stages = len(args.splits.split(","))
+    seeds = (f"127.0.0.1:{args.registry_port},"
+             f"127.0.0.1:{args.registry_port + 1}")
+    # Shared by every role: the serve processes' registry reads keep it
+    # fresh, so a client started AFTER the massacre still finds live
+    # stage-server addresses in it (writes are atomic os.replace).
+    peers_cache = os.path.join(log_dir, "peers_cache.json")
+    reg_procs = []
+    try:
+        for k, port in enumerate((args.registry_port,
+                                  args.registry_port + 1)):
+            reg_procs.append(spawn(
+                ["--mode", "registry", "--registry_port", str(port)],
+                f"rl_registry{k}"))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                registry_list(seeds)
+                break
+            except OSError:
+                time.sleep(0.3)
+        else:
+            raise SystemExit("registries did not come up")
+        print(f"registries up at {seeds}")
+
+        for i in range(1, num_stages + 1):
+            spawn(common + ["--mode", "serve", "--splits", args.splits,
+                            "--registry_addr", seeds, "--stage", str(i),
+                            "--peers_cache", peers_cache],
+                  f"rl_stage{i}")
+        deadline = time.time() + args.startup_timeout
+        while time.time() < deadline:
+            try:
+                recs = [r for r in registry_list(seeds)
+                        if str(r.state) == "online"]
+            except OSError:
+                recs = []
+            if len(recs) >= num_stages:
+                break
+            for proc, _ in procs:
+                if proc.poll() is not None:
+                    raise SystemExit(
+                        f"a swarm process exited early (rc={proc.returncode})"
+                        " — see logs in " + log_dir)
+            time.sleep(1.0)
+        else:
+            raise SystemExit("servers did not register in time — "
+                             "see logs in " + log_dir)
+        print(f"{num_stages} stage servers registered; waiting for the "
+              "peers cache")
+        # The serve processes' first gossip tick does a registry list read,
+        # which persists the cache — the fresh client's only map once the
+        # seeds are gone. Don't pull the trigger before it exists.
+        deadline = time.time() + 30
+        while time.time() < deadline and not os.path.exists(peers_cache):
+            time.sleep(0.3)
+        if not os.path.exists(peers_cache):
+            raise SystemExit("peers cache never written — see logs in "
+                             + log_dir)
+        print("peers cache written; starting client #1")
+
+        client_cmd = (
+            [sys.executable, "-m", MAIN] + common
+            + ["--mode", "client", "--splits", args.splits,
+               "--registry_addr", seeds, "--peers_cache", peers_cache,
+               "--prompt", args.prompt,
+               "--max_new_tokens", str(args.max_new_tokens),
+               "--seed", str(args.seed)])
+        log1 = open(os.path.join(log_dir, "rl_client1.log"), "w")
+        c1 = subprocess.Popen(client_cmd, cwd=REPO, env=env,
+                              stdout=log1, stderr=subprocess.STDOUT)
+        procs.append((c1, log1))
+        time.sleep(args.kill_after)
+        for rp in reg_procs:
+            if rp.poll() is None:
+                rp.kill()       # SIGKILL: no goodbye frame, no state flush
+        print("SIGKILLed the primary AND the standby registry")
+        rc1 = c1.wait(timeout=args.startup_timeout)
+        if rc1 != 0:
+            print(f"FAIL: in-flight client exited rc={rc1} — "
+                  f"logs in {log_dir}")
+            return 1
+        print("in-flight client finished across total seed loss (rc=0)")
+
+        # Fresh client: empty snapshot, every seed dead — only the cache
+        # file and the gossip mirrors stand between it and "no live servers".
+        rc2 = subprocess.call(client_cmd, cwd=REPO, env=env)
+        if rc2 != 0:
+            print(f"FAIL: fresh bootstrap client exited rc={rc2} — "
+                  f"logs in {log_dir}")
+            return 1
+        print("REGISTRY-LOSS DRILL PASS: fresh client bootstrapped through "
+              "a stage server's gossip mirror")
+        return 0
+    finally:
+        _teardown(procs)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="gpt2")
@@ -47,6 +170,14 @@ def main():
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--registry_port", type=int, default=31345)
     p.add_argument("--startup_timeout", type=float, default=600.0)
+    p.add_argument("--kill_registries", action="store_true",
+                   help="registry-loss drill: primary+standby seeds, "
+                        "SIGKILL both mid-generation, in-flight client "
+                        "must finish and a fresh client must bootstrap "
+                        "off a stage server's gossip mirror")
+    p.add_argument("--kill_after", type=float, default=2.0,
+                   help="--kill_registries: seconds after the first "
+                        "client starts before the seeds are killed")
     args = p.parse_args()
 
     num_stages = len(args.splits.split(","))  # stages 1..N (0 = client)
@@ -74,6 +205,9 @@ def main():
     common = ["--model", args.model]
     if args.checkpoint:
         common += ["--checkpoint", args.checkpoint]
+
+    if args.kill_registries:
+        return kill_registries_drill(args, env, spawn, procs, common, log_dir)
 
     try:
         # Every role consents to chaos: the `fault` admin verb is refused
@@ -128,15 +262,7 @@ def main():
             cwd=REPO, env=env)
         return rc
     finally:
-        for proc, log in procs:
-            if proc.poll() is None:
-                proc.send_signal(signal.SIGINT)
-        for proc, log in procs:
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
-            log.close()
+        _teardown(procs)
 
 
 if __name__ == "__main__":

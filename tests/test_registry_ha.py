@@ -171,3 +171,65 @@ def test_generation_survives_primary_registry_death():
             s.stop()
         standby.stop()
         # prim already stopped by the timer (stop() is idempotent there).
+
+
+# -- failover internals (round 5 satellites) ----------------------------------
+
+def test_up_order_rotates_and_demotes_backed_off_registries():
+    """Read-path ordering: indices rotate from the preferred start, but
+    registries inside their down-backoff window sink to the end — tried
+    only as a last resort until the backoff expires."""
+    rr = RemoteRegistry("127.0.0.1:1,127.0.0.1:2,127.0.0.1:3",
+                        timeout=0.05)
+    assert rr._up_order(0) == [0, 1, 2]
+    assert rr._up_order(2) == [2, 0, 1]
+
+    rr._down_until[1] = time.monotonic() + 60.0      # 1 is backing off
+    assert rr._up_order(0) == [0, 2, 1]
+    assert rr._up_order(1) == [2, 0, 1]
+
+    rr._down_until[1] = time.monotonic() - 1.0       # backoff expired
+    assert rr._up_order(1) == [1, 2, 0]
+
+
+def test_stale_persistent_socket_retries_fresh_not_down():
+    """A registry restart leaves the client's persistent socket half-open;
+    the next RPC must retry ONCE on a fresh connection instead of marking
+    the (live) registry down."""
+    a = RegistryServer()
+    a.start()
+    host, port = a.address.rsplit(":", 1)
+    rr = RemoteRegistry(a.address)
+    rr.register(_rec("p1"))             # caches the persistent socket
+    a.stop()
+    a2 = RegistryServer(host=host, port=int(port))   # restarted, EMPTY
+    a2.start()
+    try:
+        assert rr.live_servers() == []  # stale socket -> fresh retry wins
+        assert rr._down_until[0] == 0.0, "live registry marked down"
+    finally:
+        a2.stop()
+
+
+def test_register_buffered_during_outage_flushes_on_reconnect():
+    """Satellite: a register issued while EVERY registry is down is
+    buffered (last record per peer) and replayed on the first successful
+    reconnect — it must not silently vanish."""
+    a = RegistryServer()
+    a.start()
+    host, port = a.address.rsplit(":", 1)
+    rr = RemoteRegistry(a.address, timeout=0.5)
+    a.stop()
+
+    rr.register(_rec("p1"))             # total outage: buffered, no raise
+    assert "p1" in rr._pending_register
+
+    a2 = RegistryServer(host=host, port=int(port))
+    a2.start()
+    try:
+        rr.live_servers()               # first success triggers the flush
+        assert not rr._pending_register
+        assert [r.peer_id for r in a2.registry.live_servers()] == ["p1"]
+        assert [r.peer_id for r in rr.live_servers()] == ["p1"]
+    finally:
+        a2.stop()
