@@ -25,13 +25,14 @@ tokenizer when available, else a UTF-8 byte fallback so the CLI always runs.
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import os
 import random
 import re
 import sys
 import time
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -127,7 +128,7 @@ def load_model(args) -> Tuple[ModelConfig, dict]:
 
         store = _remote_store(args)
         cfg = config_from_checkpoint(store.fetch_config())
-        if args.mode in ("local", "serve", "client"):
+        if args.mode in ("local", "serve", "client", "gateway"):
             # Per-span streaming (petals from_pretrained.py:81-128): params
             # stay None; each serving role later fetches just the shards
             # covering ITS span (store.load_stage via _stage_params).
@@ -139,7 +140,7 @@ def load_model(args) -> Tuple[ModelConfig, dict]:
         full = StageSpec(0, ROLE_FULL, 0, cfg.num_layers)
         return cfg, store.load_stage(cfg, full, dtype=dtype)
     if args.checkpoint:
-        if args.mode in ("local", "serve", "client"):
+        if args.mode in ("local", "serve", "client", "gateway"):
             from .models.hf_import import config_from_checkpoint
 
             has_st = (os.path.exists(os.path.join(
@@ -944,7 +945,9 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
     # The batched engine must NOT be serialized (concurrent handler calls
     # are how its round window coalesces); the sp adapter serializes itself
     # with its own lock (one session owns the mesh anyway).
-    runtime = None if (args.batched or args.sp > 1) else StageRuntime()
+    runtime = (None if (args.batched or args.sp > 1)
+               else StageRuntime(high_water=args.queue_high_water,
+                                 low_water=args.queue_low_water))
     # Decentralized control plane: every serve process embeds a gossip
     # mirror of the placement records, so the swarm survives losing EVERY
     # dedicated registry (seeds become bootstrap-only, like DHT initial
@@ -1164,6 +1167,111 @@ def run_client(args, cfg: ModelConfig, params) -> int:
         return _generate_and_report(args, client.generate, cfg)
     finally:
         transport.close()
+
+
+def _load_tenants_config(raw: Optional[str]):
+    """Parse --tenants: inline JSON (starts with '{') or a file path;
+    omitted means one 'default' tenant with the library defaults."""
+    from .serving import parse_tenants_config
+
+    raw = raw or '{"default": {}}'
+    if not raw.lstrip().startswith("{"):
+        with open(raw) as f:
+            raw = f.read()
+    return parse_tenants_config(json.loads(raw))
+
+
+def run_gateway(args, cfg: ModelConfig, params) -> int:
+    """--mode gateway: the multi-tenant serving front door. Owns one or
+    more PipelineClients against the swarm at --registry_addr and serves
+    the framed-TCP `submit` verb (docs/SERVING.md)."""
+    from .runtime.executor import StageExecutor as _SE
+    from .runtime.net import RemoteRegistry, TcpTransport
+    from .serving import GatewayServer
+
+    tenants, max_queue_depth, max_active = _load_tenants_config(args.tenants)
+    splits = parse_splits(args.splits) if args.splits else None
+    plan = (StagePlan.from_splits(cfg.num_layers, splits) if splits
+            else StagePlan.even(cfg.num_layers, 4))
+    registry = RemoteRegistry(args.registry_addr,
+                              peers_cache=args.peers_cache)
+    transports = []
+    clients = []
+    for i in range(max(1, args.gateway_clients)):
+        tx = TcpTransport(registry, wire_dtype=args.wire_dtype,
+                          model=_model_id(args))
+        transports.append(tx)
+        stage0 = _SE(cfg, plan.stages[0],
+                     _stage_params(args, cfg, params, plan.stages[0]),
+                     peer_id=f"gateway-local-{i}")
+        clients.append(PipelineClient(
+            cfg, plan, stage0, tx, registry,
+            use_module_routing=bool(args.use_load_balancing),
+            route_by_latency=args.route_by_latency,
+            total_blocks=args.total_blocks or cfg.num_layers,
+            request_timeout=args.request_timeout,
+            seed=args.seed,
+            model=_model_id(args),
+            long_context_threshold=args.long_context_threshold,
+            metrics=_client_metrics(args),
+        ))
+    gw = GatewayServer(clients, tenants, host=args.host,
+                       port=args.rpc_port,
+                       max_queue_depth=max_queue_depth,
+                       max_active=max_active,
+                       allow_fault_injection=args.allow_fault_injection)
+    gw.start()
+    _emit(f"GATEWAY addr={gw.address} tenants={','.join(sorted(tenants))} "
+          f"clients={len(clients)} max_queue_depth={max_queue_depth} "
+          f"max_active={max_active}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        gw.stop()
+        for tx in transports:
+            tx.close()
+    return 0
+
+
+def run_submit(args) -> int:
+    """--mode submit: fire --submit_requests requests at --gateway_addr as
+    --tenant. No model weights load here — the gateway's swarm owns the
+    model; only the tokenizer (prompt encoding) is needed."""
+    from .serving import GatewaySubmitClient, Overloaded
+
+    cfg = get_config(args.model)
+    tokenizer = load_tokenizer(_remote_store(args).cache_dir
+                               if _is_remote(args.checkpoint)
+                               else args.checkpoint)
+    prompt_ids = [i % cfg.vocab_size for i in tokenizer.encode(args.prompt)]
+    client = GatewaySubmitClient(args.gateway_addr)
+    shed = 0
+    for i in range(args.submit_requests):
+        t0 = time.perf_counter()
+        try:
+            res = client.submit(
+                args.tenant, prompt_ids, args.max_new_tokens,
+                temperature=args.temperature, top_p=args.top_p,
+                top_k=args.top_k,
+                repetition_penalty=args.repetition_penalty,
+                deadline_s=args.deadline_s,
+                timeout=args.request_timeout)
+        except Overloaded as exc:
+            shed += 1
+            _emit(f"[{i}] SHED ({exc.reason}): retry after "
+                  f"{exc.retry_after_s:.3f}s -- {exc}")
+            continue
+        dt = time.perf_counter() - t0
+        _emit(f"[{i}] {len(res['tokens'])} tokens in {dt:.2f}s "
+              f"(ttft={res['ttft_s'] or 0:.3f}s "
+              f"queue_wait={res['queue_wait_s'] or 0:.3f}s "
+              f"stopped_by={res['stopped_by']}): "
+              f"{tokenizer.decode(res['tokens'])!r}")
+    # Shedding is the gateway doing its job; only all-shed is a failure.
+    return 1 if shed == args.submit_requests else 0
 
 
 # ---------------------------------------------------------------------------
@@ -1602,6 +1710,281 @@ def registry_loss_soak(cfg, params, *, prompt_ids, max_new_tokens=8, seed=0,
     return result
 
 
+def overload_soak(cfg, params, *, prompt_ids, max_new_tokens=8, seed=0,
+                  splits=None, wire_dtype="f32", request_timeout=30.0,
+                  requests_per_tenant=3, stage_params=None) -> dict:
+    """Multi-tenant overload drill (--mode chaos --chaos_scenario overload).
+
+    Boots a swarm + gateway in-process, then proves the serving tentpole's
+    three contracts end-to-end over real sockets:
+
+      * FAIRNESS — two tenants, gold:bronze weights 4:1, preload the fair
+        queue while the scheduler is paused, release it, and require the
+        served-TOKEN ratio over the contended window (up to gold's last
+        token) to land within +/-25% of the weight ratio;
+      * CORRECTNESS — every admitted request (deadline_s generous) must
+        finish in budget with tokens IDENTICAL to a sequential no-gateway
+        baseline on the same swarm/seed (interleaving is invisible);
+      * SHEDDING — a strict gateway must refuse excess load with the typed
+        Overloaded (concurrency, rate, and queue_full reasons, each with
+        retry_after_s > 0), and the doctor must reconstruct the refusals
+        from the flight-recorder ring.
+    """
+    import threading as _threading
+
+    from .runtime.executor import StageExecutor as _SE
+    from .runtime.net import (RegistryServer, RemoteRegistry, TcpStageServer,
+                              TcpTransport)
+    from .runtime.task_pool import StageRuntime
+    from .serving import (GatewayServer, GatewaySubmitClient, Overloaded,
+                          TenantConfig)
+    from .telemetry import doctor as _doc
+    from .telemetry import events as _events
+
+    _events.get_recorder().enable()
+    sampling = SamplingParams(temperature=0.0)  # greedy: token-identity oracle
+    if stage_params is None:
+        stage_params = lambda spec: slice_stage_params(cfg, params, spec)  # noqa: E731
+    plan = (StagePlan.from_splits(cfg.num_layers, splits) if splits
+            else StagePlan.even(cfg.num_layers, 4))
+    prompt_ids = list(prompt_ids)
+
+    def _variant(i: int) -> List[int]:
+        # Distinct prompt per request (a rotation): identical results would
+        # otherwise mask cross-session KV contamination.
+        k = i % max(1, len(prompt_ids))
+        return prompt_ids[k:] + prompt_ids[:k]
+
+    weights = {"gold": 4.0, "bronze": 1.0}
+    total = 2 * requests_per_tenant
+    problems: List[str] = []
+    result: dict = {"seed": seed, "weights": weights,
+                    "requests_per_tenant": requests_per_tenant}
+    reg_server = None
+    servers: List = []
+    transports: List = []
+    gateways: List = []
+    try:
+        reg_server = RegistryServer(host="127.0.0.1", port=0)
+        reg_server.start()
+        reg = RemoteRegistry(reg_server.address)
+        for spec in plan.stages[1:]:
+            ex = _SE(cfg, spec, stage_params(spec),
+                     peer_id=f"overload-s{spec.index}")
+            srv = TcpStageServer(ex, host="127.0.0.1", port=0,
+                                 wire_dtype=wire_dtype,
+                                 runtime=StageRuntime())
+            srv.start()
+            rec = make_server_record(ex.peer_id, spec)
+            rec.address = srv.address
+            reg.register(rec)
+            servers.append(srv)
+        ex0 = _SE(cfg, plan.stages[0], stage_params(plan.stages[0]),
+                  peer_id="overload-client")
+
+        def _client():
+            tx = TcpTransport(reg, wire_dtype=wire_dtype)
+            transports.append(tx)
+            return PipelineClient(cfg, plan, ex0, tx, reg,
+                                  request_timeout=request_timeout,
+                                  settle_seconds=0.0, seed=seed)
+
+        # --- sequential no-gateway baseline: the token oracle ---
+        base_client = _client()
+        baseline: Dict[int, List[int]] = {}
+        for i in range(total):
+            res = base_client.generate(
+                _variant(i), max_new_tokens, sampling=sampling,
+                session_id=f"ov-base-{i}")
+            baseline[i] = list(res.tokens)
+
+        # --- phase A: fairness + correctness under contention ---
+        tenants = {name: TenantConfig(name, weight=w, rate=1000.0,
+                                      burst=1000.0, max_concurrency=64)
+                   for name, w in weights.items()}
+        gw = GatewayServer([_client()], tenants, port=0,
+                           max_queue_depth=64, max_active=total,
+                           start_paused=True)
+        gateways.append(gw)
+        gw.start()
+        submits: Dict[int, dict] = {}
+
+        def _submit(idx: int, tenant: str):
+            try:
+                submits[idx] = GatewaySubmitClient(gw.address).submit(
+                    tenant, _variant(idx), max_new_tokens,
+                    deadline_s=60.0, session_id=f"ov-{tenant}-{idx}",
+                    timeout=request_timeout + 60.0)
+            except Exception as exc:  # noqa: BLE001 — scored below
+                submits[idx] = {"error": f"{type(exc).__name__}: {exc}"}
+
+        tenant_order = (["gold"] * requests_per_tenant
+                        + ["bronze"] * requests_per_tenant)
+        threads = []
+        for i, tenant in enumerate(tenant_order):
+            th = _threading.Thread(target=_submit, args=(i, tenant),
+                                   daemon=True)
+            th.start()
+            threads.append(th)
+        # Preload completely before releasing the scheduler: fairness is
+        # only observable when every tenant contends from step one.
+        deadline = time.monotonic() + 15.0
+        while gw.queue.depth() < total and time.monotonic() < deadline:
+            time.sleep(0.01)
+        if gw.queue.depth() < total:
+            problems.append(f"preload stalled: queued {gw.queue.depth()}"
+                            f"/{total} before resume")
+        gw.resume()
+        for th in threads:
+            th.join(timeout=request_timeout + 90.0)
+
+        for i in range(total):
+            got = submits.get(i, {"error": "submit thread never reported"})
+            if "error" in got:
+                problems.append(f"request {i} failed: {got['error']}")
+            elif got["tokens"] != baseline[i]:
+                problems.append(
+                    f"request {i}: gateway tokens {got['tokens']} != "
+                    f"sequential baseline {baseline[i]}")
+        result["queue_waits"] = sorted(
+            round(s["queue_wait_s"], 4) for s in submits.values()
+            if "queue_wait_s" in s)
+
+        # Served-token fairness over the contended window: the step log up
+        # to gold's LAST token (afterwards bronze runs uncontended).
+        log = list(gw.step_log)
+        result["step_log"] = "".join(t[0] for t in log)
+        # Gold's total comes from the BASELINE (a stop heuristic — eos/
+        # repeat — may end a session before max_new_tokens, identically in
+        # both runs), so the window cut lands on gold's true last token.
+        gold_total = sum(len(baseline[i])
+                         for i, t in enumerate(tenant_order) if t == "gold")
+        served = 0
+        cut = len(log)
+        for pos, tenant in enumerate(log):
+            if tenant == "gold":
+                served += 1
+                if served == gold_total:
+                    cut = pos + 1
+                    break
+        window = log[:cut]
+        gold_served = sum(1 for t in window if t == "gold")
+        bronze_served = len(window) - gold_served
+        result["gold_served"] = gold_served
+        result["bronze_served"] = bronze_served
+        want_ratio = weights["gold"] / weights["bronze"]
+        ratio = (gold_served / bronze_served if bronze_served
+                 else float("inf"))
+        result["ratio"] = ratio
+        # +/-25% of the weight ratio, with one quantum of absolute slack:
+        # the window necessarily cuts mid-rotation, and at tier-1 token
+        # counts a single boundary step shifts the raw ratio past 25%.
+        expected_bronze = gold_served / want_ratio
+        if (gold_served < gold_total
+                or abs(bronze_served - expected_bronze)
+                > max(1.0, 0.25 * expected_bronze)):
+            problems.append(
+                f"served-token ratio {gold_served}:{bronze_served} "
+                f"(= {ratio:.2f}) outside +/-25% of the 4:1 weights "
+                f"(expected bronze ~{expected_bronze:.1f} in the window; "
+                f"log {result['step_log']!r})")
+        gw.stop()
+
+        # --- phase B: typed shedding on a strict gateway ---
+        strict = {
+            "slow": TenantConfig("slow", rate=1000.0, burst=1000.0,
+                                 max_concurrency=1),
+            "bursty": TenantConfig("bursty", rate=1e-3, burst=1.0),
+            "filler": TenantConfig("filler", rate=1000.0, burst=1000.0),
+        }
+        gw2 = GatewayServer([_client()], strict, port=0,
+                            max_queue_depth=3, max_active=1,
+                            start_paused=True)  # never resumed: pure gate
+        gateways.append(gw2)
+        gw2.start()
+        sub2 = GatewaySubmitClient(gw2.address)
+
+        def _bg(tenant):
+            th = _threading.Thread(
+                target=lambda: _submit_quietly(sub2, tenant), daemon=True)
+            th.start()
+            return th
+
+        def _submit_quietly(cli, tenant):
+            try:
+                cli.submit(tenant, _variant(0), 2, timeout=30.0)
+            except Exception:  # noqa: BLE001 — shutdown error expected
+                pass
+
+        def _expect_shed(tenant, want_reason):
+            try:
+                sub2.submit(tenant, _variant(0), 2, timeout=10.0)
+                problems.append(
+                    f"tenant {tenant}: expected Overloaded "
+                    f"({want_reason}), request was served")
+            except Overloaded as exc:
+                result.setdefault("shed_reasons", {})[exc.reason] = round(
+                    exc.retry_after_s, 4)
+                if exc.reason != want_reason:
+                    problems.append(
+                        f"tenant {tenant}: shed reason {exc.reason!r}, "
+                        f"wanted {want_reason!r}")
+                if exc.retry_after_s <= 0:
+                    problems.append(
+                        f"tenant {tenant}: retry_after_s "
+                        f"{exc.retry_after_s} must be > 0")
+
+        def _wait_depth(n):
+            deadline = time.monotonic() + 10.0
+            while gw2.queue.depth() < n and time.monotonic() < deadline:
+                time.sleep(0.01)
+
+        bgs = [_bg("slow")]
+        _wait_depth(1)
+        _expect_shed("slow", "concurrency")     # inflight 1 >= cap 1
+        bgs.append(_bg("bursty"))
+        _wait_depth(2)
+        _expect_shed("bursty", "rate")          # burst of 1 already spent
+        bgs.append(_bg("filler"))
+        _wait_depth(3)
+        _expect_shed("filler", "queue_full")    # global watermark
+        gw2.stop()                              # fails the queued waiters
+        for th in bgs:
+            th.join(timeout=10.0)
+
+        # --- doctor: refusals must surface as failure chains ---
+        chains = _doc.failure_chains(_doc.merge_timeline(
+            [{"meta": {"pid": os.getpid()},
+              "events": [ev.to_dict()
+                         for ev in _events.get_recorder().events()]}]))
+        result["chains"] = len(chains)
+        shed_chains = [ch for ch in chains
+                       if any(ev.get("event") == "request_shed"
+                              for ev in ch["events"])]
+        result["shed_chains"] = len(shed_chains)
+        if not shed_chains:
+            problems.append("doctor chains contain no request_shed trigger "
+                            "(flight recorder missed the refusals)")
+    finally:
+        for gw_ in gateways:
+            try:
+                gw_.stop()
+            except Exception:
+                pass
+        for tx in transports:
+            try:
+                tx.close()
+            except Exception:
+                pass
+        for srv in servers:
+            srv.stop()
+        if reg_server is not None:
+            reg_server.stop()
+    result["problems"] = problems
+    result["ok"] = not problems
+    return result
+
+
 def run_chaos(args, cfg: ModelConfig, params) -> int:
     from . import telemetry
 
@@ -1636,6 +2019,32 @@ def run_chaos(args, cfg: ModelConfig, params) -> int:
             return 0
         for p in res["problems"]:
             _emit(f"REGISTRY-LOSS SOAK FAIL: {p}")
+        return 1
+    if args.chaos_scenario == "overload":
+        if args.chaos_attach:
+            _emit("OVERLOAD SOAK FAIL: --chaos_scenario overload boots its "
+                  "own swarm and gateway in-process; drop --chaos_attach")
+            return 1
+        res = overload_soak(
+            cfg, params, prompt_ids=prompt_ids,
+            max_new_tokens=args.max_new_tokens, seed=args.seed,
+            splits=splits, wire_dtype=args.wire_dtype,
+            request_timeout=args.request_timeout)
+        _emit(f"\n=== Overload soak (seed={res['seed']}, weights 4:1) ===")
+        _emit(f"served tokens (gold:bronze) : {res.get('gold_served')}:"
+              f"{res.get('bronze_served')} "
+              f"(ratio {res.get('ratio', 0.0):.2f})")
+        _emit(f"queue waits (s)             : {res.get('queue_waits')}")
+        _emit(f"shed refusals               : {res.get('shed_reasons')}")
+        _emit(f"shed chains / total         : {res.get('shed_chains', 0)}"
+              f" / {res.get('chains', 0)}")
+        if res["ok"]:
+            _emit("OVERLOAD SOAK PASS: weighted fairness held, admitted "
+                  "requests matched the sequential baseline in budget, and "
+                  "excess load was shed with typed retry hints")
+            return 0
+        for p in res["problems"]:
+            _emit(f"OVERLOAD SOAK FAIL: {p}")
         return 1
     res = chaos_soak(
         cfg, params, prompt_ids=prompt_ids,
@@ -1672,7 +2081,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mode",
                    choices=["local", "fused", "oracle",
                             "registry", "serve", "client", "status",
-                            "metrics", "doctor", "dcn-check", "chaos"],
+                            "metrics", "doctor", "dcn-check", "chaos",
+                            "gateway", "submit"],
                    default="local")
     p.add_argument("--telemetry", action="store_true",
                    help="enable the process-global metrics registry, "
@@ -1849,19 +2259,51 @@ def build_parser() -> argparse.ArgumentParser:
                         "this process (registry and serve roles). NEVER set "
                         "on a production swarm — it lets any client that "
                         "can dial the port inject faults")
-    p.add_argument("--chaos_scenario", choices=["faults", "registry_loss"],
+    p.add_argument("--chaos_scenario",
+                   choices=["faults", "registry_loss", "overload"],
                    default="faults",
                    help="chaos mode: 'faults' runs the seeded fault-"
                         "injection soak; 'registry_loss' kills the primary "
                         "AND every standby registry mid-generation and "
                         "requires identical tokens plus a gossip-served "
-                        "fresh-client bootstrap (in-process swarm only)")
+                        "fresh-client bootstrap (in-process swarm only); "
+                        "'overload' floods a two-tenant gateway and "
+                        "requires weighted-fair service, baseline-identical "
+                        "tokens, and typed shedding (in-process only)")
     p.add_argument("--chaos_attach", action="store_true",
                    help="chaos mode: instead of booting an in-process "
                         "swarm, attach to the externally launched one at "
                         "--registry_addr (its roles must all run with "
                         "--allow_fault_injection --telemetry; see "
                         "scripts/chaos_swarm.py)")
+    # Multi-tenant serving gateway (--mode gateway / submit, docs/SERVING.md)
+    p.add_argument("--tenants", default=None, metavar="JSON_OR_PATH",
+                   help="gateway mode: tenant table as inline JSON (starts "
+                        "with '{') or a path to a JSON file. Per tenant: "
+                        "weight (fair share), rate + burst (admission "
+                        "token bucket), max_concurrency; top-level "
+                        "max_queue_depth / max_active set the global "
+                        "watermark and the interleaving width. Omitted: "
+                        "one 'default' tenant with library defaults.")
+    p.add_argument("--gateway_addr", default="127.0.0.1:31340",
+                   help="submit mode: the gateway's host:port "
+                        "(--mode gateway prints it at startup)")
+    p.add_argument("--gateway_clients", type=int, default=1,
+                   help="gateway mode: number of PipelineClients the "
+                        "gateway round-robins new sessions across")
+    p.add_argument("--tenant", default="default",
+                   help="submit mode: tenant to submit as")
+    p.add_argument("--submit_requests", type=int, default=1,
+                   help="submit mode: how many requests to fire "
+                        "sequentially")
+    p.add_argument("--queue_high_water", type=int, default=None,
+                   help="serve mode: task-pool depth that fires the "
+                        "`queue_pressure level=high` flight-recorder event "
+                        "(stage falling behind; default 16)")
+    p.add_argument("--queue_low_water", type=int, default=None,
+                   help="serve mode: task-pool depth at which pressure "
+                        "relaxes back to `level=normal` (default 8; must "
+                        "be <= --queue_high_water)")
     p.add_argument("--deadline_s", type=float, default=None,
                    help="end-to-end wall-clock budget for the WHOLE "
                         "generation: each hop ships the seconds remaining, "
@@ -2237,10 +2679,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_metrics(args)  # no model needed
     if args.mode == "doctor":
         return run_doctor(args)  # no model needed
+    if args.mode == "submit":
+        return run_submit(args)  # no weights: tokenizer + preset cfg only
     cfg, params = load_model(args)
     run = {"local": run_local, "fused": run_fused, "oracle": run_oracle,
            "serve": run_serve, "client": run_client,
-           "chaos": run_chaos}[args.mode]
+           "chaos": run_chaos, "gateway": run_gateway}[args.mode]
     if args.profile:
         # SURVEY.md §5.1: the reference only had wall-clock prints; we keep
         # its metric names AND produce a real device trace.

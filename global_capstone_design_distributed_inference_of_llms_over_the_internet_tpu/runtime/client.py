@@ -31,7 +31,8 @@ import random
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, Iterator, List, Optional, Sequence,
+                    Tuple)
 
 import jax.numpy as jnp
 import numpy as np
@@ -265,6 +266,18 @@ class BeamResult:
 
 
 @dataclasses.dataclass
+class GenerationStep:
+    """One yield of ``generate_stepwise``: the tokens this pipeline round
+    produced (one for plain decode, up to K+1 for an accepted speculative
+    run). The final yield carries ``done=True`` plus the assembled
+    ``GenerationResult``; its ``new_tokens`` is empty."""
+
+    new_tokens: List[int]
+    done: bool = False
+    result: Optional["GenerationResult"] = None
+
+
+@dataclasses.dataclass
 class GenerationResult:
     tokens: List[int]
     ttft_s: float
@@ -350,6 +363,10 @@ class PipelineClient:
         # per hop on every step AND on journal replay (a replacement peer
         # must rebuild the same prompt-injected hiddens).
         self._session_prompts: Dict[str, np.ndarray] = {}
+        # Gateway-assigned tenant priority per live session (lower = more
+        # urgent); stamped onto every StageRequest the session sends so
+        # server task pools order contended work by tenant.
+        self._session_priority: Dict[str, float] = {}
         # Route cache per session KIND:
         #   "plain"  — prefers engine=batched peers (one compiled step
         #              serves every concurrent session);
@@ -914,6 +931,7 @@ class PipelineClient:
                     prefix_len=prefix_len if is_prefill else 0,
                     trace=wire_ctx,
                     deadline_budget_s=budget,
+                    priority=self._session_priority.get(session_id),
                 )
                 hop_span = tracer.start_span(
                     f"hop:{hop.key}", trace_id=root.trace_id,
@@ -1004,6 +1022,7 @@ class PipelineClient:
             start_from_position=start_from_position,
             deadline_budget_s=self._deadline_budget(
                 deadline_at, session_id, peer=hops[0].peer_id),
+            priority=self._session_priority.get(session_id),
         )
 
     def _replay_chain(self, hops: List[Hop], session_id: str,
@@ -1243,32 +1262,79 @@ class PipelineClient:
         refuse already-expired work, and an exhausted budget raises
         `DeadlineExceeded` (non-retryable) instead of burning retries on a
         response the caller has stopped waiting for."""
-        session_id = session_id or f"sess-{time.monotonic_ns():x}"
-        if deep_prompts is not None:
-            self._session_prompts[session_id] = np.asarray(deep_prompts)
-        _ev.emit("session_start", session_id=session_id,
-                 prompt_len=len(prompt_ids), max_new_tokens=max_new_tokens)
-        recoveries_before = self.recoveries
-        result = None
-        try:
-            result = self._generate_impl(
+        result: Optional[GenerationResult] = None
+        for step in self.generate_stepwise(
                 prompt_ids, max_new_tokens, sampling=sampling,
                 eos_token_id=eos_token_id, session_id=session_id,
                 max_length=max_length, speculative_k=speculative_k,
-                draft_fn=draft_fn,
-                deadline_at=(time.monotonic() + deadline_s
-                             if deadline_s is not None else None))
-            return result
+                draft_fn=draft_fn, deep_prompts=deep_prompts,
+                deadline_s=deadline_s):
+            if step.done:
+                result = step.result
+        assert result is not None  # the generator's final yield carries it
+        return result
+
+    def generate_stepwise(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int = 64,
+        *,
+        sampling: Optional[SamplingParams] = None,
+        eos_token_id: Optional[int] = None,
+        session_id: Optional[str] = None,
+        max_length: Optional[int] = None,
+        speculative_k: int = 0,
+        draft_fn=None,
+        deep_prompts=None,
+        deadline_s: Optional[float] = None,
+        deadline_at: Optional[float] = None,
+        priority: Optional[float] = None,
+    ) -> Iterator[GenerationStep]:
+        """Incremental form of ``generate``: a generator yielding a
+        ``GenerationStep`` after the prefill and after every decode round,
+        so a caller (the serving gateway) can interleave MANY sessions one
+        pipeline step at a time instead of running each back-to-back.
+        Token output is identical to ``generate`` — the per-step sampling
+        seed is ``self.seed + len(generated)``, purely session-local, so
+        interleaving cannot change what any session emits.
+
+        ``deadline_at`` is an ABSOLUTE ``time.monotonic()`` deadline
+        (overrides ``deadline_s``): the gateway stamps it at admission so
+        queue wait counts against the request's budget. ``priority`` is the
+        gateway's tenant priority (lower = more urgent), stamped on every
+        StageRequest this session sends. Session bookkeeping (KV leases,
+        deep prompts, journal) is released when the generator finishes OR
+        is closed early — abandoning it mid-stream cleans up via
+        GeneratorExit."""
+        session_id = session_id or f"sess-{time.monotonic_ns():x}"
+        if deep_prompts is not None:
+            self._session_prompts[session_id] = np.asarray(deep_prompts)
+        if priority is not None:
+            self._session_priority[session_id] = float(priority)
+        if deadline_at is None and deadline_s is not None:
+            deadline_at = time.monotonic() + deadline_s
+        _ev.emit("session_start", session_id=session_id,
+                 prompt_len=len(prompt_ids), max_new_tokens=max_new_tokens)
+        recoveries_before = self.recoveries
+        tokens_out = 0
+        try:
+            for step in self._generate_steps(
+                    prompt_ids, max_new_tokens, sampling=sampling,
+                    eos_token_id=eos_token_id, session_id=session_id,
+                    max_length=max_length, speculative_k=speculative_k,
+                    draft_fn=draft_fn, deadline_at=deadline_at):
+                tokens_out += len(step.new_tokens)
+                yield step
         finally:
-            # Error paths included: a failed session must not leak its
-            # deep-prompt tensor, KV leases, or journal entries.
+            # Error paths included: a failed or abandoned session must not
+            # leak its deep-prompt tensor, KV leases, or journal entries.
+            self._session_priority.pop(session_id, None)
             self._end_session(session_id)
             _ev.emit("session_end", session_id=session_id,
-                     tokens=(len(result.tokens)
-                             if result is not None else None),
+                     tokens=tokens_out or None,
                      recoveries=self.recoveries - recoveries_before)
 
-    def _generate_impl(
+    def _generate_steps(
         self,
         prompt_ids: Sequence[int],
         max_new_tokens: int,
@@ -1280,7 +1346,7 @@ class PipelineClient:
         speculative_k: int,
         draft_fn,
         deadline_at: Optional[float] = None,
-    ) -> GenerationResult:
+    ) -> Iterator[GenerationStep]:
         sampling = sampling or SamplingParams()
         prompt_len = len(prompt_ids)
         dp = self._session_prompts.get(session_id)
@@ -1348,6 +1414,7 @@ class PipelineClient:
         self._m_ttft.observe(ttft)
         self.last_prefill_stage_times = times
         generated.append(resp.token_id)
+        yield GenerationStep(new_tokens=[int(resp.token_id)])
 
         # ---- decode loop (src/main.py:164-211) ----
         # ONE loop serves both modes: a plain decode step is the degenerate
@@ -1415,6 +1482,7 @@ class PipelineClient:
             # Stop conditions are checked PER TOKEN inside the accepted run:
             # a round may overshoot the EOS / 5×-repeat point, and the output
             # must match single-token decoding exactly.
+            n_before = len(generated)
             stop = None
             for tok in accepted:
                 if len(generated) >= max_new_tokens:
@@ -1429,15 +1497,17 @@ class PipelineClient:
                 ) == 1:
                     stop = "repeat"
                     break
+            yield GenerationStep(new_tokens=generated[n_before:])
             if stop is not None:
                 stopped_by = stop
                 break
 
         self._m_generations.inc()
-        return GenerationResult(
-            tokens=generated, ttft_s=ttft, decode_times_s=decode_times,
-            stopped_by=stopped_by,
-        )
+        yield GenerationStep(new_tokens=[], done=True,
+                             result=GenerationResult(
+                                 tokens=generated, ttft_s=ttft,
+                                 decode_times_s=decode_times,
+                                 stopped_by=stopped_by))
 
     def _amend_speculative_journal(self, session_id: str, keep: int) -> None:
         """Truncate the just-journaled speculative entries to the accepted

@@ -121,6 +121,11 @@ class StageRequest:
     # ``deadline_rejected`` event server-side). None = no deadline (default;
     # the pre-deadline wire format, headers stay byte-identical).
     deadline_budget_s: Optional[float] = None
+    # Tenant priority assigned by the serving gateway (serving.gateway):
+    # lower is MORE urgent, fed into the server task pool's prioritizer so
+    # a heavy tenant's steps queue behind a light tenant's on a contended
+    # stage. None = no gateway (default; headers stay byte-identical).
+    priority: Optional[float] = None
 
 
 @dataclasses.dataclass

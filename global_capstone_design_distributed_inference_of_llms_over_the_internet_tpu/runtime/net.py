@@ -298,6 +298,11 @@ def _request_header(req: StageRequest, tensor_meta: dict,
         # absent unless the caller set a deadline, so legacy peers see
         # byte-identical headers.
         hdr["deadline_budget_s"] = req.deadline_budget_s
+    if req.priority is not None:
+        # Gateway-assigned tenant priority (lower = more urgent); absent
+        # unless a serving gateway stamped one, so legacy peers see
+        # byte-identical headers.
+        hdr["priority"] = req.priority
     # Model identity echo: the data-plane counterpart of the reference's
     # model-prefixed DHT keys (src/dht_utils.py:20-31). A mis-routed request
     # (wrong model's server) must fail loudly, not produce garbage activations.
@@ -345,6 +350,7 @@ def _header_to_request(h: dict, payload: bytes) -> StageRequest:
         prefix_len=h.get("prefix_len", 0),
         trace=h.get("trace"),
         deadline_budget_s=h.get("deadline_budget_s"),
+        priority=h.get("priority"),
     )
 
 
@@ -621,12 +627,15 @@ class TcpStageServer(_FramedTcpServer):
         self.allow_fault_injection = allow_fault_injection
 
     def _compute(self, kind: str, fn, *args, size: int = 1,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 priority: Optional[float] = None):
         budget = (self.compute_timeout if timeout is None
                   else min(timeout, self.compute_timeout))
         if self.runtime is None:
             return fn(*args)
-        return self.runtime.call(kind, fn, *args, size=size, timeout=budget)
+        kwargs = {} if priority is None else {"priority": priority}
+        return self.runtime.call(kind, fn, *args, size=size, timeout=budget,
+                                 **kwargs)
 
     def _relay(self, nxt: dict, nreq: StageRequest) -> Tuple[dict, bytes]:
         """Send a push-chain request to the next hop, return its raw response
@@ -1011,6 +1020,7 @@ class TcpStageServer(_FramedTcpServer):
             prefix_len=header.get("prefix_len", 0),
             trace=header.get("trace"),
             deadline_budget_s=header.get("deadline_budget_s"),
+            priority=header.get("priority"),
         )
         self._run_forward(sock, ex, req, stream=state,
                           step_timeout=state["step_timeout"])
@@ -1075,7 +1085,8 @@ class TcpStageServer(_FramedTcpServer):
 
         try:
             resp = self._compute("inference", ex.forward, req,
-                                 size=req.seq_len, timeout=step_timeout)
+                                 size=req.seq_len, timeout=step_timeout,
+                                 priority=req.priority)
         # All three map to kind="stage": the client converts that to
         # StageExecutionError, which is in its retryable taxonomy
         # (client.py failover) — a crashed generation helps nobody.
@@ -1089,6 +1100,15 @@ class TcpStageServer(_FramedTcpServer):
                      trace_id=_trace_id(req), peer=ex.peer_id,
                      phase=phase, error=str(exc)[:200])
             span.end(error=repr(exc))
+            if isinstance(exc, TaskRejected) and exc.permanent:
+                # Oversized work can never succeed on a retry or a
+                # replacement peer — a typed, non-retryable refusal keeps
+                # the client from burning its retry budget (and its
+                # circuit breaker) on it.
+                _send_frame(sock, {"verb": "error", "message": str(exc),
+                                   "kind": "stage", "task_rejected": True,
+                                   "peer": ex.peer_id})
+                return
             _send_frame(sock, {"verb": "error", "message": str(exc),
                                "kind": "stage",
                                "peer": ex.peer_id})
@@ -1282,8 +1302,10 @@ class TcpStageServer(_FramedTcpServer):
                 hdr_out["tensors"] = metas
                 _send_frame(sock, hdr_out, body)
         except (StageExecutionError, TaskRejected) as exc:
-            _send_frame(sock, {"verb": "error", "message": str(exc),
-                               "kind": "stage"})
+            hdr_err = {"verb": "error", "message": str(exc), "kind": "stage"}
+            if isinstance(exc, TaskRejected) and exc.permanent:
+                hdr_err["task_rejected"] = True
+            _send_frame(sock, hdr_err)
         except TimeoutError:
             _send_frame(sock, {"verb": "error", "kind": "stage",
                                "message": f"stage compute timed out after "
@@ -1629,6 +1651,8 @@ class TcpTransport(Transport):
                 hdr["trace"] = request.trace
             if request.deadline_budget_s is not None:
                 hdr["deadline_budget_s"] = request.deadline_budget_s
+            if request.priority is not None:
+                hdr["priority"] = request.priority
             if st["returns_tokens"] and (
                     st["window"] != list(request.generated_tokens)[-50:]):
                 # Window drifted (tokens were produced off-stream): re-seed
@@ -1726,6 +1750,14 @@ class TcpTransport(Transport):
                 raise DeadlineExceeded(
                     header.get("message",
                                f"peer {peer_id}: deadline budget exhausted"))
+            if header.get("task_rejected"):
+                # BEFORE the kind="stage" mapping, for the same reason as
+                # deadline_expired: a permanently rejected task (oversized)
+                # can never succeed on a retry or replacement peer, so it
+                # must not enter the retryable failover taxonomy.
+                raise TaskRejected(
+                    header.get("message", f"peer {peer_id}: task rejected"),
+                    permanent=True)
             if header.get("kind") == "push":
                 raise PushChainError(header.get("peer", "?"),
                                      header.get("message", "push failed"))

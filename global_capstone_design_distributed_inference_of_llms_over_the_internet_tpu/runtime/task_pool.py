@@ -32,6 +32,7 @@ import threading
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ..telemetry import catalog as _tm
 from ..telemetry import events as _ev
 
 logger = logging.getLogger(__name__)
@@ -45,7 +46,18 @@ KINDS = (KIND_INFERENCE, KIND_FORWARD, KIND_BACKWARD)
 
 
 class TaskRejected(RuntimeError):
-    """The pool refused the task (oversized, or the runtime is stopped)."""
+    """The pool refused the task (oversized, or the runtime is stopped).
+
+    ``permanent=True`` marks rejections that can NEVER succeed on any
+    retry or replacement peer (an oversized task stays oversized), so the
+    wire layer can surface them as typed non-retryable errors instead of
+    burning the client's retry budget. Transient rejections (runtime
+    stopping during shutdown) stay retryable — failover to a replacement
+    server is exactly the right response to those."""
+
+    def __init__(self, message: str, permanent: bool = False):
+        super().__init__(message)
+        self.permanent = permanent
 
 
 class TaskPrioritizerBase:
@@ -61,7 +73,13 @@ class DummyTaskPrioritizer(TaskPrioritizerBase):
     steps outrank fine-tuning forward/backward batches."""
 
     def prioritize(self, kind: str, size: int, **kwargs: Any) -> float:
-        return 1.0 if kind == KIND_INFERENCE else 2.0
+        if kind == KIND_INFERENCE:
+            # The serving gateway stamps a per-tenant priority on inference
+            # steps (StageRequest.priority, lower = more urgent); without a
+            # gateway the reference's constant applies.
+            priority = kwargs.get("priority")
+            return float(priority) if priority is not None else 1.0
+        return 2.0
 
 
 @dataclasses.dataclass(order=True)
@@ -87,14 +105,24 @@ class PrioritizedTaskPool:
     """
 
     # Pressure hysteresis: `queue_pressure level=high` fires when the queue
-    # depth reaches HIGH_WATER, `level=normal` once it drains back below
-    # LOW_WATER — the flight-recorder signal that a stage fell behind.
+    # depth reaches the high water mark, `level=normal` once it drains back
+    # below the low mark — the flight-recorder signal that a stage fell
+    # behind. Class attrs are the defaults; operators override per server
+    # via --queue_high_water/--queue_low_water.
     HIGH_WATER = 16
     LOW_WATER = 8
 
-    def __init__(self, name: str, max_batch_size: int = 8192):
+    def __init__(self, name: str, max_batch_size: int = 8192,
+                 high_water: Optional[int] = None,
+                 low_water: Optional[int] = None):
         self.name = name
         self.max_batch_size = max_batch_size
+        self.high_water = self.HIGH_WATER if high_water is None else high_water
+        self.low_water = self.LOW_WATER if low_water is None else low_water
+        if self.low_water > self.high_water:
+            raise ValueError(
+                f"pool {name}: low_water {self.low_water} must not exceed "
+                f"high_water {self.high_water}")
         self._heap: list[Task] = []
         self._lock = threading.Lock()
         self._pressured = False
@@ -106,14 +134,16 @@ class PrioritizedTaskPool:
                             f"{self.max_batch_size}")
             raise TaskRejected(
                 f"pool {self.name}: task of size {task.size} exceeds "
-                f"max_batch_size {self.max_batch_size}"
+                f"max_batch_size {self.max_batch_size}",
+                permanent=True,
             )
         with self._lock:
             heapq.heappush(self._heap, task)
             depth = len(self._heap)
-            crossed = not self._pressured and depth >= self.HIGH_WATER
+            crossed = not self._pressured and depth >= self.high_water
             if crossed:
                 self._pressured = True
+        _tm.get("server_task_queue_depth").labels(pool=self.name).set(depth)
         if crossed:
             _ev.emit("queue_pressure", pool=self.name, level="high",
                      depth=depth)
@@ -122,9 +152,12 @@ class PrioritizedTaskPool:
         with self._lock:
             task = heapq.heappop(self._heap) if self._heap else None
             depth = len(self._heap)
-            relaxed = self._pressured and depth < self.LOW_WATER
+            relaxed = self._pressured and depth < self.low_water
             if relaxed:
                 self._pressured = False
+        if task is not None:
+            _tm.get("server_task_queue_depth").labels(
+                pool=self.name).set(depth)
         if relaxed:
             _ev.emit("queue_pressure", pool=self.name, level="normal",
                      depth=depth)
@@ -156,10 +189,15 @@ class StageRuntime:
         self,
         prioritizer: Optional[TaskPrioritizerBase] = None,
         max_batch_size: int = 8192,
+        high_water: Optional[int] = None,
+        low_water: Optional[int] = None,
     ):
         self.prioritizer = prioritizer or DummyTaskPrioritizer()
         self.pools: Dict[str, PrioritizedTaskPool] = {
-            kind: PrioritizedTaskPool(kind, max_batch_size) for kind in KINDS
+            kind: PrioritizedTaskPool(kind, max_batch_size,
+                                      high_water=high_water,
+                                      low_water=low_water)
+            for kind in KINDS
         }
         self._seq = itertools.count()
         self._work = threading.Semaphore(0)
@@ -191,11 +229,12 @@ class StageRuntime:
         return task.future
 
     def call(self, kind: str, fn: Callable[..., Any], *args: Any,
-             size: int = 1, timeout: Optional[float] = None) -> Any:
+             size: int = 1, timeout: Optional[float] = None,
+             **priority_kwargs: Any) -> Any:
         """Submit and wait — the handler-thread convenience path. On timeout
         the task is cancelled (a no-op if already running) so abandoned work
         does not keep occupying the compute thread."""
-        fut = self.submit(kind, fn, *args, size=size)
+        fut = self.submit(kind, fn, *args, size=size, **priority_kwargs)
         try:
             return fut.result(timeout)
         except TimeoutError:

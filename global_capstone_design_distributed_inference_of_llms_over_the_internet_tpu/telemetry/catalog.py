@@ -175,6 +175,35 @@ SPEC: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Sequence[float]]]] = {
         COUNTER, "should_choose_other_blocks evaluations.", (), None),
     "scheduler_rebalance_moves_total": (
         COUNTER, "Rebalance checks that recommended moving.", (), None),
+    # -- server task pools ----------------------------------------------------
+    "server_task_queue_depth": (
+        GAUGE, "Tasks queued in each stage-server pool "
+               "(inference|forward|backward), the pressure signal behind "
+               "queue_pressure events.", ("pool",), None),
+    # -- serving gateway ------------------------------------------------------
+    "gateway_requests_total": (
+        COUNTER, "Requests arriving at the gateway, per tenant and outcome "
+                 "(ok|shed|error).", ("tenant", "outcome"), None),
+    "gateway_shed_total": (
+        COUNTER, "Requests refused by admission control, per tenant and "
+                 "reason (rate|concurrency|queue_full).",
+        ("tenant", "reason"), None),
+    "gateway_tokens_served_total": (
+        COUNTER, "Tokens streamed back to tenants — the quantity "
+                 "weighted-fair scheduling balances.", ("tenant",), None),
+    "gateway_queue_wait_seconds": (
+        HISTOGRAM, "Admission-to-first-pipeline-step wait in the fair "
+                   "queue.", ("tenant",), FAST_BUCKETS),
+    "gateway_ttft_seconds": (
+        HISTOGRAM, "Submit-to-first-token latency through the gateway "
+                   "(queue wait + prefill).", ("tenant",),
+        DEFAULT_LATENCY_BUCKETS),
+    "gateway_queue_depth": (
+        GAUGE, "Requests admitted but not yet started (fair-queue "
+               "backlog).", (), None),
+    "gateway_active_sessions": (
+        GAUGE, "Sessions currently being decoded by the gateway's step "
+               "scheduler.", (), None),
 }
 
 

@@ -37,6 +37,7 @@ _TRIGGERS = {
     "deadline_expired": "deadline expired",
     "deadline_rejected": "deadline rejected",
     "registry_unreachable": "registries unreachable",
+    "request_shed": "request shed",
 }
 # Events that CONTINUE a chain once triggered.
 _CHAIN = {
@@ -48,6 +49,9 @@ _CHAIN = {
     # gossip-served discovery -> seeds restored.
     "registry_stale_serve", "gossip_fallback", "gossip_served_discovery",
     "registry_recovered",
+    # Gateway fairness story: what got in and finished around a shed —
+    # a shed request's chain shows whether admission was load or a bug.
+    "request_admitted", "request_completed",
 }
 
 # Counter patterns in the embedded Prometheus exposition that should be
@@ -58,6 +62,7 @@ _ANOMALY_COUNTERS = (
     ("server_kv_alloc_failures_total", "KV allocations refused"),
     ("server_kv_evictions_total", "idle sessions evicted by the KV arena"),
     ("server_prefix_cache_evictions_total", "prefix-cache grains evicted"),
+    ("gateway_shed_total", "requests refused by gateway admission control"),
 )
 _ERR_REQ_RE = re.compile(
     r'^server_requests_total\{outcome="(error|timeout)"\} ([0-9.e+]+)',
@@ -153,6 +158,15 @@ def _describe(ev: dict) -> str:
     if name == "registry_recovered":
         return (f"registry recovered after {f.get('stale_s', '?')}s "
                 f"(via {f.get('source', '?')})")
+    if name == "request_admitted":
+        return (f"tenant {f.get('tenant', '?')} admitted "
+                f"(queue depth {f.get('queue_depth', '?')})")
+    if name == "request_shed":
+        return (f"tenant {f.get('tenant', '?')} shed ({f.get('reason', '?')}"
+                f", retry in {f.get('retry_after_s', '?')}s)")
+    if name == "request_completed":
+        return (f"tenant {f.get('tenant', '?')} served "
+                f"{f.get('tokens', '?')} tokens")
     return str(name)
 
 

@@ -216,6 +216,20 @@ EVENTS: Dict[str, Tuple[str, str, str]] = {
         "gossip", INFO,
         "An unregister became a grace-period tombstone; older live "
         "versions cannot resurrect the record (fields: peer, seq)."),
+    # -- serving gateway -----------------------------------------------------
+    "request_admitted": (
+        "gateway", INFO,
+        "Admission control accepted a tenant request into the fair queue "
+        "(fields: tenant, queue_depth, deadline_s)."),
+    "request_shed": (
+        "gateway", WARN,
+        "Admission control refused a tenant request — the caller got a "
+        "typed Overloaded with a retry hint (fields: tenant, reason, "
+        "retry_after_s)."),
+    "request_completed": (
+        "gateway", INFO,
+        "A gateway request finished streaming (fields: tenant, tokens, "
+        "queue_wait_s, outcome)."),
     # -- process ------------------------------------------------------------
     "process_start": (
         "process", INFO,

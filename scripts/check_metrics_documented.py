@@ -38,8 +38,8 @@ DOC = REPO / "docs" / "OBSERVABILITY.md"
 # Backticked tokens that look like catalog metrics. The suffix alternation
 # keeps prose like `server_forward` (a span name) out of scope.
 _DOC_METRIC_RE = re.compile(
-    r"`((?:server|client|transport|scheduler)_[a-z0-9_]+"
-    r"(?:_total|_seconds|_bytes|_ratio|_sessions|_hops))`"
+    r"`((?:server|client|transport|scheduler|gateway)_[a-z0-9_]+"
+    r"(?:_total|_seconds|_bytes|_ratio|_sessions|_hops|_depth))`"
 )
 
 # Event names in the doc's event table: backticked first-column cells.

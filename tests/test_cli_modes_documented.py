@@ -1,0 +1,20 @@
+"""Tier-1 wrapper for scripts/check_cli_modes_documented.py: every --mode
+(and --chaos_scenario) choice must be shown in use in README.md or docs/,
+and the docs must not reference modes the parser no longer offers."""
+
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_every_cli_mode_documented():
+    proc = subprocess.run(
+        [sys.executable,
+         str(REPO / "scripts" / "check_cli_modes_documented.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"CLI mode/doc drift:\n{proc.stdout}{proc.stderr}"
+    )
