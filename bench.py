@@ -531,6 +531,96 @@ def bench_serving_batched(cfg, params, *, slots=8, max_len=512, prefill=64,
     }
 
 
+def bench_serving_burst(cfg, params, *, slots=8, max_len=512, prefill=64,
+                        bursts=8, burst=16, reps=2):
+    """The BURST serving path: runtime.batching's burst_stream, ONE jitted
+    dispatch per N decode ticks (lax.scan over the whole burst, per-slot
+    active masks and on-device sampling), with the next burst dispatched
+    before the previous burst's tokens are read back. Where the per-step
+    serving row (bench_serving_batched) pays one dispatch per token per
+    round, this path amortizes the dispatch over N*slots tokens — on a
+    tunneled chip that is THE lever, so dispatches_per_token is reported
+    alongside tokens/s. Token parity with the sequential per-step client
+    is pinned by tests/test_burst.py."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.partition import (
+        ROLE_FULL,
+        StageSpec,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.batching import (
+        BatchedStageExecutor,
+    )
+
+    spec = StageSpec(index=0, role=ROLE_FULL, start=0, end=cfg.num_layers)
+    ex = BatchedStageExecutor(cfg, spec, params, slots=slots,
+                              max_len=max_len, dtype=jnp.bfloat16)
+
+    def make_entries(live_toks):
+        # temperature=1.0 sampling keeps every slot alive for the full
+        # budget (greedy on a random-init model trips the 5-run repeat
+        # stop almost immediately and the row degenerates).
+        return {sid: {"token": t, "seed": i, "budget": bursts * burst,
+                      "generated": [t], "eos": None, "temperature": 1.0,
+                      "top_p": 1.0, "top_k": 0, "repetition_penalty": 1.0}
+                for i, (sid, t) in enumerate(live_toks.items())}
+
+    def time_stream(n_live):
+        best = (float("inf"), 1, 1)
+        for r in range(reps):
+            rng = np.random.default_rng(r)
+            toks = {}
+            for s in range(slots):
+                prompt = rng.integers(0, cfg.vocab_size, prefill,
+                                      dtype=np.int32)
+                h = ex.prefill(f"s{s}", prompt[None, :])  # restarts session
+                toks[f"s{s}"] = int(jnp.argmax(ex.logits(h[:, -1:])[0, -1]))
+            live = {sid: toks[sid] for sid in list(toks)[:n_live]}
+            # one warm burst outside the clock (first rep: burst compile)
+            warm = ex.decode_burst(
+                {sid: dict(e, budget=burst)
+                 for sid, e in make_entries(live).items()}, burst)
+            live = {sid: res["tokens"][-1] for sid, res in warm.items()}
+            d0, k0 = ex.burst_dispatches, ex.burst_tokens
+            t0 = time.perf_counter()
+            n_toks = 0
+            for block in ex.burst_stream(make_entries(live), burst):
+                for res in block.values():     # _burst_collect already
+                    n_toks += len(res["tokens"])   # synced the block
+            dt = time.perf_counter() - t0
+            if dt < best[0]:
+                best = (dt, n_toks, ex.burst_dispatches - d0)
+            assert ex.burst_tokens - k0 == n_toks
+        return best
+
+    # Same rig-vs-server separation as the per-step serving row: slope the
+    # per-burst time over the live-session count (entry prep + readback
+    # framing are per-session host work), take the intercept as the
+    # co-located per-burst estimate. The raw number already amortizes the
+    # tunnel's ~100 ms per-dispatch cost over N*slots tokens.
+    n1 = max(1, slots // 2)
+    t1, k1, d1 = time_stream(n1)
+    t2, k2, d2 = time_stream(slots)
+    tb1, tb2 = t1 / max(d1, 1), t2 / max(d2, 1)
+    per_session = max(0.0, (tb2 - tb1) / (slots - n1))
+    fixed = max(tb2 - slots * per_session, 1e-6)
+    return {
+        "tokens_per_s": round(k2 / t2, 2),
+        "dispatches_per_token": round(d2 / max(k2, 1), 5),
+        "tokens_per_dispatch": round(k2 / max(d2, 1), 1),
+        "burst_ticks": burst,
+        "burst_ms": round(tb2 * 1e3, 3),
+        "per_session_rig_ms": round(per_session * 1e3, 3),
+        "burst_ms_colocated_est": round(fixed * 1e3, 3),
+        "tokens_per_s_colocated_est": round((k2 / max(d2, 1)) / fixed, 2),
+        "slots": slots, "max_len": max_len,
+        "note": "burst_stream drives one jitted lax.scan dispatch per "
+                f"{burst} ticks with the next burst in flight during "
+                "readback, so the tunnel's per-dispatch cost is amortized "
+                "over burst_ticks*slots tokens (compare "
+                "dispatches_per_token with the per-step serving row's "
+                "1/slot-count)",
+    }
+
+
 def bench_gateway(cfg, params, *, splits=(6,), n_requests=8,
                   max_new_tokens=8, wire_dtype="f32",
                   request_timeout=300.0, seed=0):
@@ -1443,6 +1533,11 @@ def main():
                          s1=8, s2=48, prefill=8, reps=2)
         rs = bench_serving_batched(cfg, params, slots=2, max_len=64,
                                    prefill=8, rounds=8, reps=1)
+        try:
+            rsb = bench_serving_burst(cfg, params, slots=2, max_len=64,
+                                      prefill=8, bursts=4, burst=4, reps=1)
+        except Exception as exc:   # burst row must not kill the smoke
+            rsb = {"error": str(exc)[:200]}
         rp = bench_prefill(cfg, params, batch=2, seq=32, n1=2, n2=8, reps=1)
         rpx = bench_prefix_cache(cfg, params, seq=96, suffix=16, reps=2)
         rpd = bench_prefix_digest(cfg, seq=128, grain=64, reps=3)
@@ -1453,7 +1548,8 @@ def main():
                                 max_new_tokens=4)
         except Exception as exc:   # the gateway row must not kill the smoke
             rgw = {"error": str(exc)[:200]}
-        cfgs = {"smoke": r, "smoke_serving": rs, "smoke_prefill": rp,
+        cfgs = {"smoke": r, "smoke_serving": rs, "smoke_serving_burst": rsb,
+                "smoke_prefill": rp,
                 "smoke_prefix_cache": rpx, "smoke_prefix_digest": rpd,
                 "smoke_telemetry_overhead": rt,
                 "smoke_recorder_overhead": rrec,
@@ -1510,6 +1606,35 @@ def main():
             gcfg, _qp(gparams, "int8"))
     except Exception as exc:
         results["gpt2_serving_batched_8slots_int8"] = {"error": str(exc)[:200]}
+    # BURST serving rows (docs/SERVING.md burst mode): one jitted lax.scan
+    # dispatch per 16 decode ticks instead of one dispatch per token, so
+    # the tunnel's ~100 ms per-dispatch cost is amortized over
+    # burst_ticks*slots tokens. dispatches_per_token is the headline
+    # structural delta vs the per-step rows above.
+    try:
+        results["gpt2_serving_burst_8slots"] = bench_serving_burst(
+            gcfg, gparams)
+    except Exception as exc:   # the burst row must not kill the bench
+        results["gpt2_serving_burst_8slots"] = {"error": str(exc)[:200]}
+    try:
+        from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.quant import (
+            quantize_params as _qp,
+        )
+
+        results["gpt2_serving_burst_8slots_int8"] = bench_serving_burst(
+            gcfg, _qp(gparams, "int8"))
+    except Exception as exc:
+        results["gpt2_serving_burst_8slots_int8"] = {"error": str(exc)[:200]}
+    # int8-vs-bf16 on the SERVING path: per-step serving is dispatch-bound,
+    # which let the r5 rows invert (int8 8.84 < bf16 11.82 tok/s — the
+    # dequant cost showed while the dispatch hid the weight-stream win).
+    # Burst serving amortizes the dispatch, so the weight-stream halving
+    # must show: the comparison field asserts int8 >= bf16 here.
+    _bb = results.get("gpt2_serving_burst_8slots", {})
+    _bq = results.get("gpt2_serving_burst_8slots_int8", {})
+    if "tokens_per_s" in _bb and "tokens_per_s" in _bq:
+        _bq["int8_ge_bf16"] = bool(
+            _bq["tokens_per_s"] >= _bb["tokens_per_s"])
     results["gpt2_prefill_b8_s512"] = bench_prefill(
         gcfg, gparams, batch=8, seq=512)
     del gparams
@@ -1621,6 +1746,31 @@ def main():
     # VERDICT r3 item 1: multi-session ring decode fills the decode bubble.
     results["pipeline_decode_multisession"] = _run_pipeline_row_subprocess(
         "--ring-row")
+    # ROADMAP radar: the repo's two multi-session decode engines on one
+    # axis. The ring fills a DEEP pipeline's bubble with G sessions (one
+    # token per tick in steady state, virtual mesh); the burst engine runs
+    # a FULL-span stage and amortizes dispatch over N ticks per program.
+    # Different axes (per-tick utilization vs per-dispatch amortization) —
+    # this row pins both structural numbers side by side.
+    try:
+        _ring = results.get("pipeline_decode_multisession", {})
+        _bst = results.get("gpt2_serving_burst_8slots", {})
+        results["multisession_ring_vs_burst"] = {
+            "ring_session_groups": _ring.get("session_groups"),
+            "ring_tick_ms": _ring.get("tick_ms"),
+            "ring_bubble_frac_measured": _ring.get("bubble_frac_measured"),
+            "burst_slots": _bst.get("slots"),
+            "burst_ticks": _bst.get("burst_ticks"),
+            "burst_tokens_per_s": _bst.get("tokens_per_s"),
+            "burst_dispatches_per_token": _bst.get("dispatches_per_token"),
+            "note": "ring decode hides the deep-pipeline decode bubble "
+                    "(per-tick utilization across stage hops); burst "
+                    "decode hides the per-token dispatch on a full-span "
+                    "stage (tokens per program). A swarm deploys both: "
+                    "ring inside a deep span, burst at the serving edge",
+        }
+    except Exception as exc:
+        results["multisession_ring_vs_burst"] = {"error": str(exc)[:200]}
     # VERDICT r4 weak item 3: ring x speculative composition ticks/token.
     results["ring_speculative"] = _run_pipeline_row_subprocess(
         "--ring-spec-row")

@@ -765,6 +765,12 @@ def _generate_and_report(args, generate_fn, cfg: ModelConfig,
         else:
             logger.warning("--deadline_s is ignored in --mode %s "
                            "(pipeline-client modes only)", args.mode)
+    if getattr(args, "burst", 0):
+        if supports_speculative:  # same gate: pipeline-client modes only
+            kw["burst"] = args.burst
+        else:
+            logger.warning("--burst is ignored in --mode %s "
+                           "(pipeline-client modes only)", args.mode)
     res = generate_fn(prompt_ids, args.max_new_tokens, sampling=sampling,
                       eos_token_id=eos, **kw)
     text = tokenizer.decode(res.tokens)
@@ -857,11 +863,25 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
     splits = parse_splits(args.splits) if args.splits else None
     plan = (StagePlan.from_splits(cfg.num_layers, splits) if splits
             else StagePlan.even(cfg.num_layers, 4))
-    if not 1 <= args.stage < plan.num_stages:
+    if args.stage == 0:
+        # Full-span server: the only shape that can run burst decode —
+        # on-device sampling feeds each tick's token straight back into
+        # the embedding, so the scan needs blocks 0..L plus the head in
+        # one process. Classic stage 0 runs inside the client, so this
+        # shape is --batched-only; --splits is ignored for the span.
+        if not args.batched:
+            raise SystemExit(
+                "--stage 0 serves the FULL model span and requires "
+                "--batched (the burst-capable continuous-batching engine); "
+                "classic stage 0 runs inside the client")
+        spec = StagePlan.even(cfg.num_layers, 1).stages[0]
+    elif not 1 <= args.stage < plan.num_stages:
         raise SystemExit(
             f"--stage must be 1..{plan.num_stages - 1} for serve mode "
-            "(stage 0 runs inside the client)")
-    spec = plan.stages[args.stage]
+            "(stage 0 runs inside the client; --stage 0 --batched serves "
+            "the full span for --burst)")
+    else:
+        spec = plan.stages[args.stage]
 
     registry = RemoteRegistry(args.registry_addr, peers_cache=args.peers_cache)
     peer_id = args.peer_id or f"stage{args.stage}-{os.getpid()}"
@@ -929,10 +949,11 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
                  tp_mesh=_serve_tp_mesh(args),
                  prefix_cache_bytes=args.prefix_cache_mb << 20)
     logger.info("warming up stage %d (pre-compiling step shapes)", args.stage)
-    if args.batched and getattr(args, "speculative_k", 0):
-        # Warm the K+1-wide batched decode step too, so the first
-        # speculative round doesn't compile inside the round leader's lock.
-        ex.warmup(speculative_k=args.speculative_k)
+    if args.batched:
+        # Warm the K+1-wide batched decode step and/or the N-tick burst
+        # program too, so neither compiles inside the round leader's lock.
+        ex.warmup(speculative_k=getattr(args, "speculative_k", 0),
+                  burst=getattr(args, "burst", 0))
     else:
         ex.warmup()
     # Per-session executors serialize compute through the prioritized
@@ -1712,7 +1733,8 @@ def registry_loss_soak(cfg, params, *, prompt_ids, max_new_tokens=8, seed=0,
 
 def overload_soak(cfg, params, *, prompt_ids, max_new_tokens=8, seed=0,
                   splits=None, wire_dtype="f32", request_timeout=30.0,
-                  requests_per_tenant=3, stage_params=None) -> dict:
+                  requests_per_tenant=3, stage_params=None,
+                  burst=0) -> dict:
     """Multi-tenant overload drill (--mode chaos --chaos_scenario overload).
 
     Boots a swarm + gateway in-process, then proves the serving tentpole's
@@ -1779,6 +1801,34 @@ def overload_soak(cfg, params, *, prompt_ids, max_new_tokens=8, seed=0,
             rec.address = srv.address
             reg.register(rec)
             servers.append(srv)
+        if burst > 0:
+            # Burst mode: gateway sessions decode in N-tick jitted bursts
+            # against a FULL-span batched server. Its record advertises
+            # stage_index=0 so classic stage routing (which queries stages
+            # 1..N-1) never sees it — the sequential baseline below still
+            # runs the per-step path, making it the token oracle for the
+            # burst-served gateway requests.
+            from .models.partition import ROLE_FULL, StageSpec
+            from .runtime.batching import (BatchedStageExecutor,
+                                           BatchingStageAdapter)
+
+            full = StageSpec(index=0, role=ROLE_FULL, start=0,
+                             end=cfg.num_layers)
+            blen = max(len(prompt_ids) + max_new_tokens + burst + 8, 64)
+            bex = BatchedStageExecutor(cfg, full, stage_params(full),
+                                       slots=max(2 * requests_per_tenant, 4),
+                                       max_len=blen)
+            bad = BatchingStageAdapter(bex, window_s=0.0,
+                                       peer_id="overload-burst")
+            bad.warmup(burst=burst)
+            bsrv = TcpStageServer(bad, host="127.0.0.1", port=0,
+                                  wire_dtype=wire_dtype)
+            bsrv.start()
+            brec = make_server_record(bad.peer_id, full, engine="batched")
+            brec.address = bsrv.address
+            reg.register(brec)
+            servers.append(bsrv)
+            result["burst"] = burst
         ex0 = _SE(cfg, plan.stages[0], stage_params(plan.stages[0]),
                   peer_id="overload-client")
 
@@ -1804,7 +1854,7 @@ def overload_soak(cfg, params, *, prompt_ids, max_new_tokens=8, seed=0,
                    for name, w in weights.items()}
         gw = GatewayServer([_client()], tenants, port=0,
                            max_queue_depth=64, max_active=total,
-                           start_paused=True)
+                           start_paused=True, burst=burst)
         gateways.append(gw)
         gw.start()
         submits: Dict[int, dict] = {}
@@ -1879,10 +1929,13 @@ def overload_soak(cfg, params, *, prompt_ids, max_new_tokens=8, seed=0,
         # +/-25% of the weight ratio, with one quantum of absolute slack:
         # the window necessarily cuts mid-rotation, and at tier-1 token
         # counts a single boundary step shifts the raw ratio past 25%.
+        # Under burst serving the service quantum is a whole burst (one
+        # pick = up to N tokens, charged to the DRR after the fact), so
+        # the boundary slack is one burst, not one token.
         expected_bronze = gold_served / want_ratio
         if (gold_served < gold_total
                 or abs(bronze_served - expected_bronze)
-                > max(1.0, 0.25 * expected_bronze)):
+                > max(float(burst or 1), 0.25 * expected_bronze)):
             problems.append(
                 f"served-token ratio {gold_served}:{bronze_served} "
                 f"(= {ratio:.2f}) outside +/-25% of the 4:1 weights "
@@ -2029,8 +2082,11 @@ def run_chaos(args, cfg: ModelConfig, params) -> int:
             cfg, params, prompt_ids=prompt_ids,
             max_new_tokens=args.max_new_tokens, seed=args.seed,
             splits=splits, wire_dtype=args.wire_dtype,
-            request_timeout=args.request_timeout)
-        _emit(f"\n=== Overload soak (seed={res['seed']}, weights 4:1) ===")
+            request_timeout=args.request_timeout,
+            burst=getattr(args, "burst", 0))
+        _emit(f"\n=== Overload soak (seed={res['seed']}, weights 4:1"
+              + (f", burst={res['burst']}" if res.get("burst") else "")
+              + ") ===")
         _emit(f"served tokens (gold:bronze) : {res.get('gold_served')}:"
               f"{res.get('bronze_served')} "
               f"(ratio {res.get('ratio', 0.0):.2f})")
@@ -2207,6 +2263,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve --batched: max concurrent sessions")
     p.add_argument("--max_session_len", type=int, default=2048,
                    help="serve --batched: per-slot KV capacity (tokens)")
+    p.add_argument("--burst", type=int, default=0, metavar="N",
+                   help="burst decode: one jitted dispatch runs N decode "
+                        "ticks with on-device sampling on a FULL-span "
+                        "--batched server (tokens bit-identical to per-"
+                        "step decode). client mode: decode in N-token "
+                        "bursts; serve --batched: pre-compile the N-tick "
+                        "burst program at warmup; chaos overload: drive "
+                        "the gateway at burst granularity. 0 disables")
     # Sequence-parallel long-context serving (SURVEY §5.7 exceed-the-
     # reference axis: the reference's KV must fit one machine)
     p.add_argument("--sp", type=int, default=1,

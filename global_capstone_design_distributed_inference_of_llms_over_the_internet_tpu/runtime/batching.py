@@ -22,9 +22,20 @@ Scope: the batched path covers plain greedy/sampled decode AND speculative
 verification — a draft step is rows of [last_accepted, d_1..d_K], i.e. a
 multi-token batched forward plus per-row accept/reject, so spec sessions
 coalesce the same way plain ones do (rounds are keyed by step width T; all
-requests in a round share one compiled step). Beam reorder, training, and
-replay still ride the per-session StageExecutor — servers route those
-requests to it unchanged.
+requests in a round share one compiled step). Beam reorder and training
+still ride the per-session StageExecutor — servers route those requests to
+it unchanged. Replay is accepted (prefill + multi-token KV rebuild rounds)
+so a replacement batched peer can adopt a failed-over burst session.
+
+BURST DECODE (the continuous-batching serving core, ROADMAP Open item 1):
+a FULL-SPAN batched engine can additionally run N decode ticks in ONE
+jitted dispatch — ``lax.scan`` over ticks, each tick embedding the carry
+token, running the layer scan, sampling ON DEVICE with the session-local
+seed schedule ``PRNGKey(step_seed + i)`` (bit-identical to the sequential
+``_sample_rows`` path), and maintaining per-slot alive masks so eos /
+repeat / budget stops truncate mid-scan without a host round trip. The
+host pays one dispatch per N tokens instead of one per token, and
+``burst_stream`` double-buffers dispatch k+1 against burst k's readback.
 """
 
 from __future__ import annotations
@@ -57,7 +68,30 @@ from .kv_cache import round_to_bucket
 
 Params = Dict[str, Any]
 
+
+def _burst_entry(rq) -> dict:
+    """A StageRequest's burst spec in the engine's stateless per-burst form
+    (everything the wire ships every step, so failover needs no server-side
+    sampler state — the module-docstring contract)."""
+    sp = rq.sampling
+    return {
+        "token": int(np.asarray(rq.hidden).reshape(-1)[0]),
+        "seed": int(rq.step_seed),
+        "budget": int(rq.burst_budget),
+        "eos": rq.eos_token_id,
+        "generated": rq.generated_tokens,
+        "temperature": sp.temperature,
+        "top_p": sp.top_p,
+        "top_k": sp.top_k,
+        "repetition_penalty": sp.repetition_penalty,
+    }
+
 PREFILL_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
+
+# The client's repeat-stop heuristic (runtime.client.REPEAT_STOP), mirrored
+# on device so a burst truncates exactly where the sequential host loop
+# would have stopped. Keep the two in lockstep.
+BURST_REPEAT_STOP = 5
 
 
 class SlotFull(RuntimeError):
@@ -143,6 +177,13 @@ class BatchedStageExecutor:
         self.decode_steps = 0                          # batched steps executed
         self._prefill_jit = None
         self._decode_jits: Dict[int, Any] = {}         # step width T -> jit
+        # Burst decode (full-span engines only): n_ticks -> jitted scan.
+        self._burst_jits: Dict[int, Any] = {}
+        self.burst_dispatches = 0          # burst programs executed
+        self.burst_tokens = 0              # tokens emitted by bursts
+        self._m_burst_ticks = _tm.get("server_burst_ticks")
+        self._m_burst_disp = _tm.get("server_burst_dispatches_total")
+        self._m_burst_toks = _tm.get("server_burst_tokens_total")
         # Prompt-prefix KV reuse (runtime.prefix_cache), slot-layout
         # variant: entries hold [L, G, Hkv, Dh] KV segments (+ [1, G, D]
         # output rows off the final stage). Same grain-chained rolling
@@ -642,6 +683,318 @@ class BatchedStageExecutor:
         return {sid: h[s:s + 1] for sid, s in zip(sids, rows)}
 
     # ------------------------------------------------------------------
+    # Burst decode: N ticks per dispatch, sampling on device
+    # ------------------------------------------------------------------
+
+    def _build_burst(self, n_ticks: int):
+        """N decode ticks in one program: ``lax.scan`` over ticks, each tick
+        a T=1 batched decode body (same graph as ``_build_decode(1)``) plus
+        the final head and per-slot sampling.
+
+        Determinism contract: tick i of a slot whose request shipped
+        ``step_seed`` samples with ``PRNGKey(step_seed + i)`` — exactly the
+        key the sequential client would ship for that token (its step_seed
+        is ``seed + len(generated)``), and the same ``sample_token`` /
+        ``push_recent`` math as executor._sample_rows, so burst tokens are
+        bit-identical to the per-tick baseline.
+
+        Host stop rules are mirrored ON DEVICE, in the host's order (cap
+        via the ``left`` budget counter, then eos, then the 5-run repeat
+        heuristic), so the emitted count per slot always matches what the
+        sequential client would have accepted."""
+        cfg, spec = self.cfg, self.spec
+        S = self.slots
+        N = n_ticks
+        from ..models.transformer import lm_head
+        from ..ops.sampling import push_recent, sample_token
+
+        @partial(jax.jit, donate_argnums=engine_donation(14, 15))
+        def fn(params, tok, lengths, alive, seeds, recent, nvalid, run,
+               left, eos_id, temp, top_p, top_k, rp, k_all, v_all):
+            pos_grid = jnp.arange(k_all.shape[2], dtype=jnp.int32)
+            len0 = lengths
+
+            def tick(carry, i):
+                (tok, lengths, alive, recent, nvalid, run, left,
+                 stop, k_all, v_all) = carry
+                active = alive
+                x = tok[:, None]                              # [S, 1] ids
+                positions = lengths[:, None]                  # [S, 1]
+                h = embed_tokens(cfg, params["embed"], x, positions)
+                rope = make_rope(cfg, positions)
+                groups = cfg.num_heads // cfg.num_kv_heads
+                qpos = positions[:, :, None]                  # [S, 1, 1]
+                allowed = pos_grid[None, None, :] <= qpos
+                if cfg.sliding_window:
+                    allowed &= (pos_grid[None, None, :]
+                                > qpos - cfg.sliding_window)
+
+                def layer(h, lp_kv):
+                    lp, (k_l, v_l) = lp_kv
+                    from ..models.quant import dequant_tree
+
+                    lp = dequant_tree(lp)
+                    a = _norm(cfg, lp["ln1"], h)
+                    q, k, v = qkv_proj(cfg, lp["attn"], a)
+                    if rope is not None:
+                        q = apply_rope(q, *rope)
+                        k = apply_rope(k, *rope)
+                    upd = jax.vmap(
+                        lambda cache, new, start, act:
+                        jax.lax.dynamic_update_slice_in_dim(
+                            cache,
+                            jnp.where(
+                                act, new,
+                                jax.lax.dynamic_slice_in_dim(
+                                    cache, start, 1, 0)),
+                            start, 0)
+                    )
+                    k_l = upd(k_l, k.astype(k_l.dtype), lengths, active)
+                    v_l = upd(v_l, v.astype(v_l.dtype), lengths, active)
+                    qg = q.reshape(S, 1, cfg.num_kv_heads, groups,
+                                   cfg.head_dim)
+                    scores = jnp.einsum(
+                        "bthgd,bshd->bhgts", qg * _qscale(cfg),
+                        k_l.astype(q.dtype),
+                        preferred_element_type=jnp.float32)
+                    m = _layer_mask(lp, allowed, qpos,
+                                    pos_grid[None, None, :])
+                    scores = _softcap_and_mask(cfg, scores, m[:, None, None])
+                    probs = jax.nn.softmax(scores, axis=-1)
+                    out = jnp.einsum("bhgts,bshd->bthgd",
+                                     probs.astype(v_l.dtype),
+                                     v_l.astype(q.dtype))
+                    out = _dot(out.reshape(S, 1, -1), lp["attn"]["wo"])
+                    if "bo" in lp["attn"]:
+                        out = out + lp["attn"]["bo"]
+                    h = _residual(cfg, lp, h, out)
+                    return h, (k_l, v_l)
+
+                h, (k_all, v_all) = jax.lax.scan(
+                    layer, h, (params["layers"], (k_all, v_all)))
+                h = jnp.where(active[:, None, None], h, 0.0)
+                logits = lm_head(cfg, params, h)[:, 0]        # [S, V] fp32
+                keys = jax.vmap(jax.random.PRNGKey)(seeds + i)
+                sampled = jax.vmap(sample_token)(
+                    keys, logits, recent, nvalid, temp, top_p, top_k, rp)
+                # Host stop-rule mirror, in host order: the token is always
+                # EMITTED (the host appends before checking eos/repeat);
+                # stops only gate the NEXT tick.
+                eos_hit = active & (eos_id >= 0) & (sampled == eos_id)
+                run_next = jnp.where(sampled == tok, run + 1, jnp.int32(1))
+                run_next = jnp.where(active, run_next, run)
+                rep_hit = active & (run_next >= BURST_REPEAT_STOP)
+                left_next = jnp.where(active, left - 1, left)
+                rec2, nv2 = jax.vmap(push_recent)(recent, nvalid, sampled)
+                recent = jnp.where(active[:, None], rec2, recent)
+                nvalid = jnp.where(active, nv2, nvalid)
+                lengths = jnp.where(active, lengths + 1, lengths)
+                first = stop == 0
+                stop = jnp.where(eos_hit & first, jnp.int32(1), stop)
+                stop = jnp.where(rep_hit & ~eos_hit & first,
+                                 jnp.int32(2), stop)
+                alive = active & ~eos_hit & ~rep_hit & (left_next > 0)
+                tok = jnp.where(active, sampled, tok)
+                out_tok = jnp.where(active, sampled, jnp.int32(-1))
+                return (tok, lengths, alive, recent, nvalid, run_next,
+                        left_next, stop, k_all, v_all), out_tok
+
+            stop0 = jnp.zeros((S,), jnp.int32)
+            carry, toks = jax.lax.scan(
+                tick,
+                (tok, lengths, alive, recent, nvalid, run, left, stop0,
+                 k_all, v_all),
+                jnp.arange(N, dtype=jnp.int32))
+            (tok, lengths, alive, recent, nvalid, run, left, stop,
+             k_all, v_all) = carry
+            # Seed base for a CONTINUATION burst: one key was consumed per
+            # emitted token (emitted ticks are a prefix of the scan).
+            seeds = seeds + (lengths - len0)
+            return (toks, stop, tok, lengths, alive, seeds, recent, nvalid,
+                    run, left, k_all, v_all)
+
+        return fn
+
+    def _get_burst_jit(self, n_ticks: int):
+        fn = self._burst_jits.get(n_ticks)
+        if fn is None:
+            fn = self._burst_jits[n_ticks] = self._build_burst(n_ticks)
+        return fn
+
+    def _burst_prep(self, entries: Dict[str, dict], n_ticks: int):
+        """Pack per-session burst specs into the jit's [S]-shaped args.
+
+        entries[sid]: {token, seed, budget, eos (-1 = none), generated,
+        temperature, top_p, top_k, repetition_penalty} — the stateless
+        per-burst mirror of what the wire protocol ships every step, so
+        failover needs no server-side sampler state."""
+        from ..ops.sampling import RECENT_WINDOW
+
+        if not (self.spec.is_first and self.spec.is_last):
+            raise RuntimeError(
+                "burst decode requires the full model span (on-device "
+                "sampling feeds tokens straight back into the embedding)")
+        if n_ticks < 1:
+            raise ValueError(f"burst of {n_ticks} ticks")
+        S = self.slots
+        tok0 = np.zeros((S,), np.int32)
+        seeds = np.zeros((S,), np.int32)
+        recent = np.zeros((S, RECENT_WINDOW), np.int32)
+        nvalid = np.zeros((S,), np.int32)
+        run0 = np.zeros((S,), np.int32)
+        left = np.zeros((S,), np.int32)
+        eos = np.full((S,), -1, np.int32)
+        temp = np.zeros((S,), np.float32)
+        top_p = np.ones((S,), np.float32)
+        top_k = np.zeros((S,), np.int32)
+        rp = np.ones((S,), np.float32)
+        alive = np.zeros((S,), bool)
+        rows: Dict[str, int] = {}
+        for sid, e in entries.items():
+            s = self._slot_of.get(sid)
+            if s is None:
+                raise KeyError(f"unknown session {sid} (prefill first)")
+            budget = min(int(e["budget"]), n_ticks)
+            if budget < 1:
+                raise ValueError(f"session {sid}: burst budget must be >= 1")
+            if int(self.lengths[s]) + budget > self.max_len:
+                raise RuntimeError(
+                    f"session {sid}: burst of {budget} past length "
+                    f"{int(self.lengths[s])} exceeds max_len {self.max_len}")
+            gen = tuple(int(t) for t in e["generated"])
+            win = gen[-RECENT_WINDOW:]
+            if win:
+                recent[s, :len(win)] = win
+            nvalid[s] = len(win)
+            r = 0
+            for t in reversed(gen):
+                if t != gen[-1]:
+                    break
+                r += 1
+            run0[s] = r
+            tok0[s] = int(e["token"])
+            seeds[s] = int(e["seed"])
+            left[s] = budget
+            eos[s] = int(e.get("eos", -1) if e.get("eos") is not None else -1)
+            temp[s] = float(e["temperature"])
+            top_p[s] = float(e["top_p"])
+            top_k[s] = int(e["top_k"])
+            rp[s] = float(e["repetition_penalty"])
+            alive[s] = True
+            rows[sid] = s
+        args = (jnp.asarray(tok0), jnp.asarray(self.lengths),
+                jnp.asarray(alive), jnp.asarray(seeds), jnp.asarray(recent),
+                jnp.asarray(nvalid), jnp.asarray(run0), jnp.asarray(left),
+                jnp.asarray(eos), jnp.asarray(temp), jnp.asarray(top_p),
+                jnp.asarray(top_k), jnp.asarray(rp))
+        return rows, args
+
+    _BURST_STOPS = {0: None, 1: "eos", 2: "repeat"}
+
+    def _burst_collect(self, rows: Dict[str, int], toks, stop,
+                       lengths_new) -> Dict[str, dict]:
+        """Read one burst's results back (the only host sync per burst)."""
+        toks_np = np.asarray(toks)            # [N, S]
+        stop_np = np.asarray(stop)
+        len_np = np.asarray(lengths_new)
+        out: Dict[str, dict] = {}
+        total = 0
+        for sid, s in rows.items():
+            m = int(len_np[s] - self.lengths[s])
+            emitted = [int(t) for t in toks_np[:m, s]]
+            total += m
+            out[sid] = {"tokens": emitted,
+                        "stop": self._BURST_STOPS[int(stop_np[s])],
+                        "cache_len": int(len_np[s])}
+            self.lengths[s] = int(len_np[s])
+        self.burst_tokens += total
+        self._m_burst_toks.inc(total)
+        return out
+
+    def decode_burst(self, entries: Dict[str, dict],
+                     n_ticks: int) -> Dict[str, dict]:
+        """Run up to ``n_ticks`` decode ticks for every session in
+        ``entries`` in ONE jitted dispatch. Returns {session_id: {tokens,
+        stop, cache_len}} — ``tokens`` are the emitted ids (<= n_ticks;
+        device-side eos/repeat/budget stops truncate), ``stop`` is
+        None/"eos"/"repeat". Sessions join/leave only between bursts."""
+        if not entries:
+            return {}
+        rows, args = self._burst_prep(entries, n_ticks)
+        fn = self._get_burst_jit(n_ticks)
+        out = fn(self.params, *args, self.k, self.v)
+        toks, stop = out[0], out[1]
+        lengths_new = out[3]
+        self.k, self.v = out[-2], out[-1]
+        self.decode_steps += 1
+        self.burst_dispatches += 1
+        self._m_burst_disp.inc()
+        self._m_burst_ticks.observe(n_ticks)
+        return self._burst_collect(rows, toks, stop, lengths_new)
+
+    def burst_stream(self, entries: Dict[str, dict], n_ticks: int):
+        """Double-buffered burst driver (generator): every carry — tokens,
+        lengths, alive masks, sampler state, KV — stays DEVICE-RESIDENT
+        across bursts, and burst k+1 is dispatched BEFORE burst k's tokens
+        are read back, so on an async backend the host-side readback and
+        framing of burst k overlap the device executing burst k+1. Yields
+        one {session_id: {tokens, stop, cache_len}} block per burst (empty
+        blocks are skipped). The in-process serving/bench driver for one
+        resident cohort; the wire path uses per-burst ``decode_burst``."""
+        if not entries:
+            return
+        rows, args = self._burst_prep(entries, n_ticks)
+        fn = self._get_burst_jit(n_ticks)
+        remaining = {sid: int(e["budget"]) for sid, e in entries.items()}
+        finished: Dict[str, bool] = {sid: False for sid in entries}
+        # _burst_prep clamps the ``left`` counter to ONE burst's ticks (the
+        # per-dispatch wire contract); a stream spans many bursts, so seed
+        # the carry with the FULL budget instead — it ticks down on device
+        # across dispatches and a slot goes dead exactly when its total
+        # budget is spent, no host round-trip in between.
+        left_full = np.zeros((self.slots,), np.int32)
+        for sid, s in rows.items():
+            b = int(entries[sid]["budget"])
+            if int(self.lengths[s]) + b > self.max_len:
+                raise RuntimeError(
+                    f"session {sid}: stream budget of {b} past length "
+                    f"{int(self.lengths[s])} exceeds max_len {self.max_len}")
+            left_full[s] = b
+        carry, static = args[:8], args[8:]   # sampler params never change
+        carry = carry[:7] + (jnp.asarray(left_full),)
+        pending: List[tuple] = []
+        done = False
+        while not done or pending:
+            if not done:
+                out = fn(self.params, *carry, *static, self.k, self.v)
+                toks, stop = out[0], out[1]
+                carry = out[2:10]
+                self.k, self.v = out[-2], out[-1]
+                self.decode_steps += 1
+                self.burst_dispatches += 1
+                self._m_burst_disp.inc()
+                self._m_burst_ticks.observe(n_ticks)
+                # out[3] is the post-burst lengths (device array, not yet
+                # read back — _burst_collect does the sync).
+                pending.append((toks, stop, out[3]))
+            # Keep exactly one burst in flight: read back the OLDEST burst
+            # only once a newer one has been dispatched (or we are done).
+            while pending and (done or len(pending) > 1):
+                block = self._burst_collect(rows, *pending.pop(0))
+                live = {}
+                for sid, res in block.items():
+                    m = len(res["tokens"])
+                    remaining[sid] -= m
+                    if res["stop"] is not None or remaining[sid] <= 0:
+                        finished[sid] = True
+                    if m:
+                        live[sid] = res
+                if all(finished.values()):
+                    done = True
+                if live:
+                    yield live
+
+    # ------------------------------------------------------------------
 
     def logits(self, hidden: jnp.ndarray) -> jnp.ndarray:
         """Final-stage head over [1, T, D] -> [1, T, V] (fp32)."""
@@ -726,7 +1079,10 @@ class BatchingStageAdapter:
         self.step_timeout = step_timeout
         self.requests_served = 0
         self._lock = threading.Lock()
-        self._rounds: Dict[int, _Round] = {}   # step width T -> open round
+        # Open coalescing rounds, keyed by step width T (classic decode /
+        # speculative verify) or ('burst', N) (burst rounds never share a
+        # compiled program with single-tick rounds).
+        self._rounds: Dict[Any, _Round] = {}
         # Telemetry (global registry; strict no-op unless enabled). Step
         # latency itself is observed at the serving boundary (LocalTransport
         # / TcpStageServer) — the adapter owns the batching-specific signals.
@@ -738,7 +1094,7 @@ class BatchingStageAdapter:
         # tables so a batched server advertises real admission headroom.
         self.arena = _SlotArenaView(inner, self._lock)
 
-    def warmup(self, speculative_k: int = 0) -> None:
+    def warmup(self, speculative_k: int = 0, burst: int = 0) -> None:
         """Pre-compile the engine's programs (prefill at the smallest
         bucket + the batched decode step) so the first real session doesn't
         pay compile latency — the serve-mode analogue of StageExecutor.warmup.
@@ -765,6 +1121,18 @@ class BatchingStageAdapter:
                 # own program shape — warm it too, or the first speculative
                 # round compiles it inside the leader's lock.
                 self.inner.logits(out["__warmup__"])
+        if burst > 0 and self.spec.is_first and self.spec.is_last:
+            # The burst scan is by far the largest program (N unrolled-ish
+            # ticks under a scan + head + sampler); compiling it inside the
+            # first real round's lock hold would stall every session AND
+            # the heartbeat's arena view for the whole compile.
+            self.inner.rewind("__warmup__", 4)
+            self.inner.decode_burst(
+                {"__warmup__": {"token": 1, "seed": 0, "budget": burst,
+                                "eos": None, "generated": (1,),
+                                "temperature": 0.0, "top_p": 1.0,
+                                "top_k": 0, "repetition_penalty": 1.0}},
+                burst)
         self.inner.end_session("__warmup__")
 
     # -- protocol ----------------------------------------------------------
@@ -774,13 +1142,13 @@ class BatchingStageAdapter:
 
         self.requests_served += 1
         if (req.train or req.hypo_ids is not None or req.num_logprobs
-                or req.is_replay or req.prompts is not None
+                or req.prompts is not None
                 or req.start_from_position not in (None, req.cur_len)):
             _ev.emit("task_rejected", session_id=req.session_id,
                      pool="batched", reason="unsupported request kind")
             raise StageExecutionError(
-                "batched peer serves plain prefill/decode and speculative "
-                "verify only (route beam/training/replay/deep-prompt "
+                "batched peer serves plain prefill/decode, speculative "
+                "verify, and replay only (route beam/training/deep-prompt "
                 "requests to a per-session replica)")
         if req.start_block is not None and (
                 req.start_block != self.spec.start
@@ -791,12 +1159,28 @@ class BatchingStageAdapter:
                 "batched peer serves its full span only")
         if req.is_prefill:
             return self._prefill(req)
+        if req.burst_len:
+            if not (self.spec.is_first and self.spec.is_last):
+                _ev.emit("task_rejected", session_id=req.session_id,
+                         pool="batched", reason="burst without full span")
+                raise StageExecutionError(
+                    "burst decode requires a full-span peer (on-device "
+                    "sampling feeds tokens back into the embedding)")
+            if req.seq_len != 1 or req.draft_tokens is not None:
+                raise StageExecutionError(
+                    "a burst step carries exactly the one last accepted "
+                    "token")
+            return self._decode_burst(req)
         if req.draft_tokens is not None:
             if req.seq_len != len(req.draft_tokens) + 1:
                 raise StageExecutionError(
                     f"speculative step carries {req.seq_len} positions for "
                     f"{len(req.draft_tokens)} drafts (want K+1)")
-        elif req.seq_len != 1:
+        elif req.seq_len != 1 and not req.is_replay:
+            # Replay chunks are plain multi-token KV rebuilds (the client
+            # discards the sampled token) — exactly decode_batch's T>1
+            # shape, so a replacement batched peer can adopt a failed-over
+            # burst session without per-session machinery.
             raise StageExecutionError(
                 "batched decode is single-token (chunked continuation "
                 "belongs to the per-session executor)")
@@ -949,6 +1333,101 @@ class BatchingStageAdapter:
             return StageResponse(session_id=sid, tokens=tokens,
                                  n_accepted=n_acc, cache_len=r.lengths[sid])
         return self._respond(req, r.outs[sid], r.lengths[sid])
+
+    def _validate_burst(self, req) -> Optional[str]:
+        """Burst-specific admission on top of ``_validate`` (caller holds
+        the lock): mirror every condition the engine's ``_burst_prep``
+        would raise on, so one bad session never poisons its round-mates
+        with a whole-round failure."""
+        if req.burst_budget < 1:
+            return (f"session {req.session_id}: burst budget "
+                    f"{req.burst_budget} (want >= 1)")
+        s = self.inner.slot(req.session_id)
+        cur = int(self.inner.lengths[s])
+        budget = min(int(req.burst_budget), int(req.burst_len))
+        if cur + budget > self.inner.max_len:
+            return (f"session {req.session_id}: burst of {budget} past "
+                    f"{cur} exceeds max_len {self.inner.max_len}")
+        return None
+
+    def _decode_burst(self, req):
+        """Coalesce concurrent burst requests into ONE N-tick dispatch —
+        the same leader/follower round machinery as ``_decode``, keyed by
+        ('burst', N) so classic single-tick rounds and burst rounds never
+        mix widths. Sessions join/leave only at round (= burst)
+        boundaries."""
+        from .executor import StageExecutionError
+        from .messages import StageResponse
+
+        sid = req.session_id
+        n = int(req.burst_len)
+        key = ("burst", n)
+        t_join = time.monotonic()
+        with self._lock:
+            reason = self._validate(req) or self._validate_burst(req)
+            if reason is not None:
+                raise StageExecutionError(reason)
+            r = self._rounds.get(key)
+            if r is None or r.closed:
+                r = self._rounds[key] = _Round()
+                leader = True
+            else:
+                leader = False
+            if sid in r.reqs:
+                raise StageExecutionError(
+                    f"session {sid}: concurrent decode for one session")
+            r.reqs[sid] = req
+        if leader:
+            try:
+                time.sleep(self.window_s)
+                with self._lock:
+                    r.closed = True
+                    if self._rounds.get(key) is r:
+                        del self._rounds[key]
+                    good = {}
+                    for s_id, rq in r.reqs.items():
+                        reason = (self._validate(rq)
+                                  or self._validate_burst(rq))
+                        if reason is None:
+                            good[s_id] = rq
+                        else:
+                            r.bad[s_id] = reason
+                    if good:
+                        r.t_exec = time.monotonic()
+                        self._m_fill.observe(len(good))
+                        r.outs = self.inner.decode_burst(
+                            {s_id: _burst_entry(rq)
+                             for s_id, rq in good.items()}, n)
+                        r.lengths = {
+                            s_id: int(
+                                self.inner.lengths[self.inner.slot(s_id)])
+                            for s_id in good
+                        }
+                        self._m_round.observe(time.monotonic() - r.t_exec)
+                        _ev.emit("burst_round", sessions=len(good), ticks=n,
+                                 tokens=sum(len(o["tokens"])
+                                            for o in r.outs.values()))
+            except Exception as exc:  # whole-round failure
+                r.err = exc
+                with self._lock:
+                    r.closed = True
+                    if self._rounds.get(key) is r:
+                        del self._rounds[key]
+            finally:
+                r.event.set()
+        elif not r.event.wait(self.step_timeout):
+            raise StageExecutionError("batched step timed out")
+        if r.t_exec:
+            self._m_queue_wait.observe(max(0.0, r.t_exec - t_join))
+        if r.err is not None:
+            raise StageExecutionError(str(r.err)) from r.err
+        if sid in r.bad:
+            raise StageExecutionError(r.bad[sid])
+        out = r.outs[sid]
+        return StageResponse(session_id=sid,
+                             burst_tokens=tuple(out["tokens"]),
+                             burst_stop=out["stop"],
+                             cache_len=r.lengths[sid])
 
     def _verify_spec_rows(self, r: _Round, good: Dict[str, Any]) -> None:
         """Per-row speculative verification on the final stage (caller holds

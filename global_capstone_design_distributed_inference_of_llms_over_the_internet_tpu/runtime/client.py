@@ -56,6 +56,9 @@ REPEAT_STOP = 5           # 5 consecutive identical tokens, src/main.py:197-204
 # A coalesced replay chunk must stay replayable: the executor pads sequences
 # up to SEQ_BUCKETS whose largest entry is 8192.
 MAX_COALESCED_TOKENS = 4096
+# Journal/route key for the single full-span hop a burst session pins
+# (_generate_steps_burst); _rediscover_excluding special-cases it.
+BURST_HOP_KEY = "burst"
 
 
 # Engines that serve prefill/decode of their FULL span only: they refuse
@@ -827,6 +830,12 @@ class PipelineClient:
         return peer
 
     def _rediscover_excluding(self, hop: Hop, exclude: Tuple[str, ...]) -> Optional[str]:
+        if hop.key == BURST_HOP_KEY:
+            # A burst session can only fail over onto another full-span
+            # batched peer (burst requests need on-device sampling over the
+            # whole model; batched engines DO accept replay since the burst
+            # refactor — prefill + multi-token KV-rebuild chunks).
+            return self._discover_burst_peer(exclude=exclude)
         # The replacement receives the session's REPLAY journal (is_replay +
         # multi-token chunks), which single-session engines refuse — avoid.
         if self.use_module_routing:
@@ -1238,6 +1247,7 @@ class PipelineClient:
         draft_fn=None,
         deep_prompts=None,
         deadline_s: Optional[float] = None,
+        burst: int = 0,
     ) -> GenerationResult:
         """``deep_prompts`` ([total_blocks, pre_seq, D]) enables
         inference-time deep prompt tuning: each step, every server injects
@@ -1268,7 +1278,7 @@ class PipelineClient:
                 eos_token_id=eos_token_id, session_id=session_id,
                 max_length=max_length, speculative_k=speculative_k,
                 draft_fn=draft_fn, deep_prompts=deep_prompts,
-                deadline_s=deadline_s):
+                deadline_s=deadline_s, burst=burst):
             if step.done:
                 result = step.result
         assert result is not None  # the generator's final yield carries it
@@ -1289,6 +1299,7 @@ class PipelineClient:
         deadline_s: Optional[float] = None,
         deadline_at: Optional[float] = None,
         priority: Optional[float] = None,
+        burst: int = 0,
     ) -> Iterator[GenerationStep]:
         """Incremental form of ``generate``: a generator yielding a
         ``GenerationStep`` after the prefill and after every decode round,
@@ -1305,8 +1316,21 @@ class PipelineClient:
         StageRequest this session sends. Session bookkeeping (KV leases,
         deep prompts, journal) is released when the generator finishes OR
         is closed early — abandoning it mid-stream cleans up via
-        GeneratorExit."""
+        GeneratorExit.
+
+        ``burst > 0`` asks a FULL-SPAN batched final-stage peer to run up
+        to that many decode ticks per dispatch (one jitted ``lax.scan``
+        with on-device sampling — see runtime.batching ``decode_burst``),
+        yielding one GenerationStep per BURST instead of per token. The
+        per-tick seed schedule is identical to the sequential path
+        (``self.seed + len(generated)``), so tokens are bit-identical;
+        when no burst-capable peer is live the session falls back to the
+        classic per-step loop (a ``burst_fallback`` event records why)."""
         session_id = session_id or f"sess-{time.monotonic_ns():x}"
+        if burst > 0 and (speculative_k > 0 or deep_prompts is not None):
+            raise ValueError(
+                "burst decode samples on-device and is incompatible with "
+                "speculative drafting / deep prompts")
         if deep_prompts is not None:
             self._session_prompts[session_id] = np.asarray(deep_prompts)
         if priority is not None:
@@ -1317,12 +1341,19 @@ class PipelineClient:
                  prompt_len=len(prompt_ids), max_new_tokens=max_new_tokens)
         recoveries_before = self.recoveries
         tokens_out = 0
+        if burst > 0:
+            steps = self._generate_steps_burst(
+                prompt_ids, max_new_tokens, sampling=sampling,
+                eos_token_id=eos_token_id, session_id=session_id,
+                max_length=max_length, burst=burst, deadline_at=deadline_at)
+        else:
+            steps = self._generate_steps(
+                prompt_ids, max_new_tokens, sampling=sampling,
+                eos_token_id=eos_token_id, session_id=session_id,
+                max_length=max_length, speculative_k=speculative_k,
+                draft_fn=draft_fn, deadline_at=deadline_at)
         try:
-            for step in self._generate_steps(
-                    prompt_ids, max_new_tokens, sampling=sampling,
-                    eos_token_id=eos_token_id, session_id=session_id,
-                    max_length=max_length, speculative_k=speculative_k,
-                    draft_fn=draft_fn, deadline_at=deadline_at):
+            for step in steps:
                 tokens_out += len(step.new_tokens)
                 yield step
         finally:
@@ -1489,6 +1520,158 @@ class PipelineClient:
                     break
                 generated.append(int(tok))
                 context.append(int(tok))
+                if eos_token_id is not None and tok == eos_token_id:
+                    stop = "eos"
+                    break
+                if len(generated) >= REPEAT_STOP and len(
+                    set(generated[-REPEAT_STOP:])
+                ) == 1:
+                    stop = "repeat"
+                    break
+            yield GenerationStep(new_tokens=generated[n_before:])
+            if stop is not None:
+                stopped_by = stop
+                break
+
+        self._m_generations.inc()
+        yield GenerationStep(new_tokens=[], done=True,
+                             result=GenerationResult(
+                                 tokens=generated, ttft_s=ttft,
+                                 decode_times_s=decode_times,
+                                 stopped_by=stopped_by))
+
+    def _discover_burst_peer(self, exclude: Tuple[str, ...] = ()) -> Optional[str]:
+        """A live batched FINAL-stage peer covering the whole model — the
+        only server shape that can run a burst (on-device sampling feeds
+        the next tick's embedding, so the scan needs blocks 0..total plus
+        the head in one process). Highest advertised throughput wins."""
+        cands = [
+            r for r in self.registry.live_servers(model=self.model)
+            if r.engine == "batched" and r.final_stage
+            and r.start_block <= 0 and r.end_block >= self.total_blocks
+            and r.peer_id not in exclude
+            and getattr(r, "state", "online") == "online"
+        ]
+        if not cands:
+            return None
+        return max(cands, key=lambda r: r.throughput).peer_id
+
+    def _generate_steps_burst(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int,
+        *,
+        sampling: Optional[SamplingParams],
+        eos_token_id: Optional[int],
+        session_id: str,
+        max_length: Optional[int],
+        burst: int,
+        deadline_at: Optional[float] = None,
+    ) -> Iterator[GenerationStep]:
+        """Burst counterpart of ``_generate_steps``: the whole session runs
+        on ONE full-span batched peer, and each decode round ships a
+        ``burst_len`` request the server answers with up to N tokens from a
+        single jitted multi-tick dispatch. The client's per-token stop scan
+        stays authoritative (the device mirrors it only to stop WRITING);
+        the journal records one multi-token entry per burst — the tokens
+        whose KV the burst wrote — so failover replay rebuilds a
+        replacement peer across burst boundaries exactly."""
+        sampling = sampling or SamplingParams()
+        prompt_len = len(prompt_ids)
+        max_length = max_length or (prompt_len + max_new_tokens)
+        peer = self._discover_burst_peer()
+        if peer is None:
+            _ev.emit("burst_fallback", session_id=session_id,
+                     reason="no full-span batched peer is live")
+            yield from self._generate_steps(
+                prompt_ids, max_new_tokens, sampling=sampling,
+                eos_token_id=eos_token_id, session_id=session_id,
+                max_length=max_length, speculative_k=0, draft_fn=None,
+                deadline_at=deadline_at)
+            return
+        hop = Hop(key=BURST_HOP_KEY, peer_id=peer, start_block=0,
+                  end_block=self.total_blocks, expect_token=True)
+        generated: List[int] = []
+        stopped_by = "max_tokens"
+
+        # ---- prefill: raw prompt ids straight to the full-span peer ----
+        t0 = time.monotonic()
+        ids = np.asarray(prompt_ids, np.int32)[None, :]
+        resp = self._call_with_recovery(hop, StageRequest(
+            session_id=session_id, hidden=jnp.asarray(ids),
+            seq_len=prompt_len, cur_len=0, is_prefill=True,
+            max_length=max_length, sampling=sampling, step_seed=self.seed,
+            start_block=hop.start_block, end_block=hop.end_block,
+            prefix_len=prompt_len,
+            deadline_budget_s=self._deadline_budget(
+                deadline_at, session_id, peer=hop.peer_id),
+            priority=self._session_priority.get(session_id),
+        ))
+        if not resp.is_token:
+            raise RuntimeError(
+                f"burst peer {hop.peer_id} returned no prefill token")
+        self._journal_append(hop.key, session_id,
+                             JournalEntry(ids, prompt_len, 0))
+        ttft = time.monotonic() - t0
+        self._m_ttft.observe(ttft)
+        generated.append(int(resp.token_id))
+        yield GenerationStep(new_tokens=[generated[-1]])
+
+        # ---- burst decode loop ----
+        decode_times: List[float] = []
+        cur_len = prompt_len
+        while len(generated) < max_new_tokens:
+            # Host stop rules FIRST, same order as the sequential loop —
+            # the burst's last emitted token may be an EOS/repeat the
+            # device could not act on (stops only gate the NEXT tick).
+            if eos_token_id is not None and generated[-1] == eos_token_id:
+                stopped_by = "eos"
+                break
+            if len(generated) >= REPEAT_STOP and len(
+                set(generated[-REPEAT_STOP:])
+            ) == 1:
+                stopped_by = "repeat"
+                break
+            t0 = time.monotonic()
+            resp = self._call_with_recovery(hop, StageRequest(
+                session_id=session_id,
+                hidden=jnp.asarray([[generated[-1]]], jnp.int32),
+                seq_len=1, cur_len=cur_len, is_prefill=False,
+                max_length=max_length, sampling=sampling,
+                generated_tokens=clip_generated(generated),
+                step_seed=self.seed + len(generated),
+                start_block=hop.start_block, end_block=hop.end_block,
+                burst_len=burst,
+                burst_budget=min(burst, max_new_tokens - len(generated)),
+                eos_token_id=eos_token_id,
+                deadline_budget_s=self._deadline_budget(
+                    deadline_at, session_id, peer=hop.peer_id),
+                priority=self._session_priority.get(session_id),
+            ))
+            if not resp.is_burst:
+                raise RuntimeError(
+                    f"burst peer {hop.peer_id} returned no token block")
+            toks = list(resp.burst_tokens)
+            # Journal the burst's KV footprint: the carried-in token plus
+            # every emitted token except the last (whose KV the device has
+            # not written — it is the NEXT burst's carry).
+            self._journal_append(hop.key, session_id, JournalEntry(
+                np.asarray([[generated[-1], *toks[:-1]]], np.int32),
+                len(toks), cur_len))
+            dt = time.monotonic() - t0
+            decode_times.append(dt)
+            self._m_step.observe(dt)
+            self._m_tokens.inc(len(toks))
+            cur_len += len(toks)
+            # Per-token truncation scan, identical to the sequential loop:
+            # the device may legally overshoot the host's stop point by
+            # ticks it could not see (cap mid-window) — never emit those.
+            n_before = len(generated)
+            stop = None
+            for tok in toks:
+                if len(generated) >= max_new_tokens:
+                    break
+                generated.append(int(tok))
                 if eos_token_id is not None and tok == eos_token_id:
                     stop = "eos"
                     break

@@ -126,6 +126,22 @@ class StageRequest:
     # a heavy tenant's steps queue behind a light tenant's on a contended
     # stage. None = no gateway (default; headers stay byte-identical).
     priority: Optional[float] = None
+    # Burst decode (continuous-batching serving core): ask a full-span
+    # batched final stage to run up to ``burst_len`` decode ticks in ONE
+    # jitted dispatch, sampling on-device with the session-local seed
+    # schedule (PRNGKey(step_seed + i) for tick i) so tokens stay
+    # bit-identical to the sequential path. ``hidden`` carries the single
+    # last accepted token id as a [1, 1] int array; the response is a
+    # ``burst_tokens`` block. ``burst_budget`` caps the EMITTED tokens
+    # below burst_len (the session's remaining allowance) without forcing
+    # a second jit compile for the final partial burst. 0 = classic
+    # per-tick decode (default; headers stay byte-identical).
+    burst_len: int = 0
+    burst_budget: int = 0
+    # End-of-sequence token the DEVICE must stop at mid-burst (mirrors the
+    # client's host-side stop rule so emitted counts match). None = no eos
+    # stop (the classic path never ships one).
+    eos_token_id: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -176,6 +192,12 @@ class StageResponse:
     # rewinding past the rejected tail.
     tokens: Optional[Tuple[int, ...]] = None
     n_accepted: Optional[int] = None
+    # Burst mode (request.burst_len > 0): the tokens EMITTED by one burst
+    # dispatch (<= burst_len; device-side stop rules truncate), plus why
+    # the burst ended early: None (budget/burst boundary), "eos", or
+    # "repeat". cache_len reflects the KV length after all emitted ticks.
+    burst_tokens: Optional[Tuple[int, ...]] = None
+    burst_stop: Optional[str] = None
     # Server-side span summary for the request's trace (telemetry.tracing
     # Span.to_wire()): the serving peer's own wall-clock start/end plus attrs
     # (peer id, blocks). None when the request carried no trace. On a push
@@ -194,6 +216,10 @@ class StageResponse:
     @property
     def is_beam(self) -> bool:
         return self.top_tokens is not None
+
+    @property
+    def is_burst(self) -> bool:
+        return self.burst_tokens is not None
 
 
 def clip_generated(tokens: Sequence[int], window: int = 50) -> Tuple[int, ...]:

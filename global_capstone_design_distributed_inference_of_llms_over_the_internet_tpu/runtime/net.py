@@ -303,6 +303,14 @@ def _request_header(req: StageRequest, tensor_meta: dict,
         # unless a serving gateway stamped one, so legacy peers see
         # byte-identical headers.
         hdr["priority"] = req.priority
+    if req.burst_len:
+        # Burst decode (runtime.batching burst engine): absent on the
+        # classic per-tick path, so legacy peers see byte-identical
+        # headers.
+        hdr["burst_len"] = req.burst_len
+        hdr["burst_budget"] = req.burst_budget
+    if req.eos_token_id is not None:
+        hdr["eos_token_id"] = req.eos_token_id
     # Model identity echo: the data-plane counterpart of the reference's
     # model-prefixed DHT keys (src/dht_utils.py:20-31). A mis-routed request
     # (wrong model's server) must fail loudly, not produce garbage activations.
@@ -351,6 +359,9 @@ def _header_to_request(h: dict, payload: bytes) -> StageRequest:
         trace=h.get("trace"),
         deadline_budget_s=h.get("deadline_budget_s"),
         priority=h.get("priority"),
+        burst_len=h.get("burst_len", 0),
+        burst_budget=h.get("burst_budget", 0),
+        eos_token_id=h.get("eos_token_id"),
     )
 
 
@@ -1131,7 +1142,17 @@ class TcpStageServer(_FramedTcpServer):
         # rides the response so the CLIENT records both sides of the hop).
         span.set(cache_len=resp.cache_len).end()
         wire_span = span.to_wire() if req.trace is not None else None
-        if resp.is_token:
+        if getattr(resp, "is_burst", False):
+            frame = {
+                "verb": "burst", "session_id": resp.session_id,
+                "tokens": list(resp.burst_tokens),
+                "stop": resp.burst_stop,
+                "cache_len": resp.cache_len,
+            }
+            if wire_span is not None:
+                frame["span"] = wire_span
+            _send_frame(sock, frame)
+        elif resp.is_token:
             if stream is not None and resp.token_id is not None:
                 # Maintain the stream's server-side recent-token window
                 # (the client never re-ships it on the stream path).
@@ -1463,7 +1484,7 @@ class TcpTransport(Transport):
         return (self.use_streams and not request.train
                 and request.hypo_ids is None and request.num_logprobs == 0
                 and request.draft_tokens is None and not request.is_replay
-                and request.prompts is None)
+                and request.prompts is None and not request.burst_len)
 
     def _capabilities(self, peer_id: str) -> Optional[dict]:
         """The peer's cached `info` reply (capability flags: version, lora,
@@ -1714,6 +1735,14 @@ class TcpTransport(Transport):
                 session_id=header["session_id"],
                 tokens=tuple(header["tokens"]),
                 n_accepted=header["n_accepted"],
+                cache_len=header["cache_len"],
+                span=span,
+            )
+        if verb == "burst":
+            return StageResponse(
+                session_id=header["session_id"],
+                burst_tokens=tuple(header["tokens"]),
+                burst_stop=header.get("stop"),
                 cache_len=header["cache_len"],
                 span=span,
             )

@@ -53,8 +53,12 @@ class DeficitRoundRobin:
                 self._deficit[t] = 0.0
         n = len(self._order)
         # Bounded: one full rotation grants every active tenant a quantum
-        # >= 1, so a serve happens within 2n iterations.
-        for _ in range(2 * n + 1):
+        # >= 1, so a serve happens within 2n iterations — unless a tenant
+        # was burst-charged into debt (charge(); deficit << 0), in which
+        # case it needs one extra rotation per unit of debt to re-earn
+        # credit before its next serve.
+        debt = max(0.0, -min(self._deficit[t] for t in active))
+        for _ in range((2 * n) * (int(debt) + 1) + 1):
             t = self._order[self._idx]
             if t in active and self._deficit[t] >= 1.0:
                 self._deficit[t] -= 1.0
@@ -64,6 +68,17 @@ class DeficitRoundRobin:
             if t in active:
                 self._deficit[t] += self._quantum[t]
         raise AssertionError("DRR failed to converge")  # pragma: no cover
+
+    def charge(self, tenant: str, units: float) -> None:
+        """Debit service beyond the single unit ``pick()`` already took —
+        burst serving charges one pick N tokens, not 1 (each scheduler
+        pick runs an N-tick burst). The deficit may go negative; the
+        tenant re-earns credit across subsequent rotations, which is
+        exactly how classic DRR amortizes variable packet sizes, so
+        served-TOKEN ratios still converge to the weights at burst
+        granularity."""
+        if tenant in self._deficit and units > 0:
+            self._deficit[tenant] -= float(units)
 
 
 class FairQueue:

@@ -94,10 +94,18 @@ class GatewayServer(_FramedTcpServer):
                  host: str = "127.0.0.1", port: int = 0, *,
                  max_queue_depth: int = 64, max_active: int = 8,
                  start_paused: bool = False,
-                 allow_fault_injection: bool = False):
+                 allow_fault_injection: bool = False,
+                 burst: int = 0):
         if not clients:
             raise ValueError("gateway needs at least one PipelineClient")
         self.clients = list(clients)
+        # burst > 0: sessions decode in N-tick bursts (one jitted dispatch
+        # per scheduler pick — PipelineClient burst mode). Fairness,
+        # deadlines, and shedding then operate at BURST granularity: a DRR
+        # pick is charged the burst's token count (fair_queue.charge), the
+        # deadline budget is re-stamped per burst, and sessions join/leave
+        # the decode set only between bursts.
+        self.burst = int(burst)
         self.tenants = dict(tenants)
         weights = {name: cfg.weight for name, cfg in tenants.items()}
         self.admission = AdmissionController(tenants,
@@ -174,6 +182,7 @@ class GatewayServer(_FramedTcpServer):
             # Lower = more urgent server-side: a tenant with 4x the weight
             # gets 1/4 the queue-priority value on contended stage pools.
             priority=1.0 / cfg.weight,
+            burst=self.burst,
         )
         sess = _ActiveSession(req, stepper, queue_wait)
         self._sessions[req.session_id] = sess
@@ -227,6 +236,11 @@ class GatewayServer(_FramedTcpServer):
                 sess.tokens += 1
                 self.step_log.append(tenant)
                 sess.req.sink.put(("token", int(tok)))
+            if self.burst and len(step.new_tokens) > 1:
+                # One scheduler pick served a whole burst: charge the DRR
+                # the tokens beyond the single unit pick() already took,
+                # so served-token ratios keep tracking the weights.
+                self._step_drr.charge(tenant, len(step.new_tokens) - 1)
         if step.done:
             self._finish_session(sess, "ok", step.result)
         else:

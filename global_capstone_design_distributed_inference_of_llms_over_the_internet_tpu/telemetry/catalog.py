@@ -175,6 +175,19 @@ SPEC: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Sequence[float]]]] = {
         COUNTER, "should_choose_other_blocks evaluations.", (), None),
     "scheduler_rebalance_moves_total": (
         COUNTER, "Rebalance checks that recommended moving.", (), None),
+    # -- burst decode (continuous-batching serving core) ----------------------
+    "server_burst_dispatches_total": (
+        COUNTER, "Burst decode programs dispatched (each runs up to N "
+                 "ticks for every active slot in one jitted call).",
+        (), None),
+    "server_burst_tokens_total": (
+        COUNTER, "Tokens emitted by burst decode dispatches; divide "
+                 "server_burst_dispatches_total by this for "
+                 "dispatches-per-token (the amortization the burst engine "
+                 "exists to win).", (), None),
+    "server_burst_ticks": (
+        HISTOGRAM, "Configured tick count per burst dispatch (the N of "
+                   "each lax.scan program).", (), FILL_BUCKETS),
     # -- server task pools ----------------------------------------------------
     "server_task_queue_depth": (
         GAUGE, "Tasks queued in each stage-server pool "

@@ -171,6 +171,14 @@ EVENTS: Dict[str, Tuple[str, str, str]] = {
     "task_rejected": (
         "server", ERROR,
         "The task pool refused work (fields: pool, reason)."),
+    "burst_round": (
+        "server", DEBUG,
+        "A batched final stage ran one multi-tick burst dispatch (fields: "
+        "sessions, ticks, tokens)."),
+    "burst_fallback": (
+        "client", WARN,
+        "A burst-mode session fell back to per-step decode because no "
+        "full-span batched peer was live (fields: reason)."),
     # -- scheduler / registry -----------------------------------------------
     "route_planned": (
         "scheduler", DEBUG,

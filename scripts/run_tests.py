@@ -50,14 +50,16 @@ SHARDS = [
     # dispatch and is fixed at the engines (tests/conftest.py quarantine
     # note, utils.platform.engine_donation).
     ["test_batch_sampling.py", "test_batching.py", "test_beam_search.py"],
-    ["test_checkpoint_streaming.py", "test_chunked_prefill.py",
-     "test_chunked_wire.py", "test_cli.py", "test_paged_attention.py"],
+    ["test_burst.py", "test_checkpoint_streaming.py",
+     "test_chunked_prefill.py", "test_chunked_wire.py", "test_cli.py",
+     "test_cli_modes_documented.py", "test_paged_attention.py"],
     # 2: distributed bring-up + elastic serving
     ["test_dcn.py", "test_elastic_server.py", "test_finetune.py",
      "test_fused_decode.py", "test_ici_pipeline.py", "test_kv_cache.py",
      "test_load_balancing.py"],
     # 3: oracles + registry + wire
-    ["test_models_oracle.py", "test_multi_model.py", "test_net.py",
+    ["test_metrics_documented.py", "test_models_oracle.py",
+     "test_multi_model.py", "test_net.py", "test_no_bare_print.py",
      "test_offload.py", "test_partition.py", "test_registry_ha.py"],
     # 4: protocol extensions
     ["test_push_chain.py", "test_nf4_kernel.py", "test_prefix_cache.py",
@@ -66,11 +68,17 @@ SHARDS = [
      "test_routing_rtt.py"],
     # 5: pipeline runtime + serving engines
     ["test_runtime_pipeline.py", "test_serve_batched.py",
-     "test_serve_sp.py", "test_serve_tp.py", "test_sp_stage.py"],
+     "test_serve_sp.py", "test_serve_tp.py", "test_serving.py",
+     "test_sp_stage.py"],
     # 6: speculative + swarm + parallel math
     ["test_speculative.py", "test_swarm_launcher.py", "test_task_pool.py",
      "test_tensor_parallel.py", "test_throughput.py", "test_trainer.py",
      "test_deep_prompts.py"],
+    # 7: observability + control plane (added PRs 1-4; each boots small
+    # in-process swarms — grouped so their compiles share one process
+    # without crowding the engine shards)
+    ["test_events.py", "test_faults.py", "test_gossip.py",
+     "test_telemetry.py"],
 ]
 
 

@@ -377,6 +377,26 @@ def test_overload_soak_fairness_tokens_and_shedding():
     assert res["shed_chains"] >= 1
 
 
+def test_overload_soak_burst_granularity():
+    """The soak with --burst 4: gateway sessions decode in 4-tick jitted
+    bursts against a full-span batched peer (the sequential no-gateway
+    baseline stays per-step — it is the token oracle), sessions join/
+    leave at burst boundaries, and the DRR is charged N tokens per pick,
+    so the served-token fairness window still tracks the 4:1 weights at
+    burst granularity."""
+    cfg = tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    res = overload_soak(cfg, params, prompt_ids=[1, 2, 3, 4, 5],
+                        max_new_tokens=6, seed=0, splits=(3, 5),
+                        wire_dtype="f32", request_timeout=30.0,
+                        requests_per_tenant=2, burst=4)
+    assert res["ok"], res["problems"]
+    assert res["burst"] == 4
+    assert res["gold_served"] > 0 and res["bronze_served"] > 0
+    # Burst scheduling must not break the admission gates either.
+    assert set(res["shed_reasons"]) == {"rate", "concurrency", "queue_full"}
+
+
 @pytest.mark.slow
 def test_gateway_multiprocess_drill():
     """Full-fidelity serving path: registry, stage servers, gateway, and a
