@@ -250,6 +250,19 @@ def nf4_kernel_enabled() -> bool:
     return os.environ.get("NF4_KERNEL", "0") == "1"
 
 
+def int8_fold_enabled() -> bool:
+    """INT8_FOLD=1 (default ON) keeps per-layer 2-D int8 leaves packed so
+    the matmul sites stream the int8 bytes and apply the per-channel
+    scale in the matmul EPILOGUE (ops.int8_kernel: ``(x @ q) * s``)
+    instead of materializing a full bf16 weight per layer first — the
+    difference between 0.65 and roofline `frac_of_sustained` on decode.
+    INT8_FOLD=0 restores the dequant-materialize path (bit-for-bit the
+    round-5 behavior) as the kill switch."""
+    import os
+
+    return os.environ.get("INT8_FOLD", "1") == "1"
+
+
 def dequant_tree(tree: Params) -> Params:
     """Materialize full-precision weights for any quantized leaves (int8 or
     NF4). Identity (and free) for unquantized trees; under jit+scan this
@@ -258,14 +271,19 @@ def dequant_tree(tree: Params) -> Params:
 
     With `nf4_kernel_enabled()`, per-layer (2-D) NF4 leaves stay packed —
     the matmul sites (`models.transformer._dot`) feed them to the fused
-    kernel; stacked/expert (3-D) NF4 leaves still materialize (the MoE
-    einsums have no kernel path)."""
+    kernel. With `int8_fold_enabled()` (default), per-layer (2-D) int8
+    leaves stay packed the same way and run the scale-folded epilogue
+    (ops.int8_kernel). Stacked/expert (3-D) leaves of either format
+    still materialize (the MoE einsums have no kernel path)."""
     keep_nf4 = nf4_kernel_enabled()
+    keep_int8 = int8_fold_enabled()
 
     def f(x):
         if not isinstance(x, _QUANT_TYPES):
             return x
         if keep_nf4 and isinstance(x, NF4Tensor) and x.packed.ndim == 2:
+            return x
+        if keep_int8 and isinstance(x, QuantizedTensor) and x.q.ndim == 2:
             return x
         return x.dequant()
 

@@ -164,16 +164,22 @@ def _psum_if(x: jnp.ndarray, tp_axis: Optional[str]) -> jnp.ndarray:
 
 
 def _dot(x: jnp.ndarray, w) -> jnp.ndarray:
-    """Weight matmul with NF4-kernel dispatch: a packed NF4Tensor leaf
+    """Weight matmul with quantized dispatch: a packed NF4Tensor leaf
     (left intact by dequant_tree under NF4_KERNEL=1) runs the fused Pallas
-    dequant-matmul (ops.nf4_kernel); plain arrays take the ordinary
-    matmul. One helper so every projection site dispatches identically."""
-    from .quant import NF4Tensor
+    dequant-matmul (ops.nf4_kernel); a packed QuantizedTensor leaf (left
+    intact under INT8_FOLD, the default) runs the scale-folded int8
+    epilogue (ops.int8_kernel); plain arrays take the ordinary matmul.
+    One helper so every projection site dispatches identically."""
+    from .quant import NF4Tensor, QuantizedTensor
 
     if isinstance(w, NF4Tensor):
         from ..ops.nf4_kernel import nf4_dot
 
         return nf4_dot(x, w)
+    if isinstance(w, QuantizedTensor):
+        from ..ops.int8_kernel import int8_dot
+
+        return int8_dot(x, w)
     return x @ w
 
 
@@ -211,24 +217,57 @@ def qkv_proj(cfg: ModelConfig, p: Params, x: jnp.ndarray):
             v.reshape(b, t, -1, dh))
 
 
+def _concat_out_axis(leaves):
+    """Concatenate projection weights along the OUTPUT axis across all
+    three leaf layouts — exact for each: plain arrays concat directly
+    (fusing along N never changes a column's K-reduction); QuantizedTensor
+    concats q and the per-output-channel s (every output column keeps its
+    own scale); NF4Tensor concats packed codes and per-block scales
+    (absmax blocks live on the input axis, untouched by an N concat).
+    Returns None for mixed or unfusable leaf types — the fusions no-op
+    rather than guess."""
+    from .quant import NF4Tensor, QuantizedTensor
+
+    if all(isinstance(w, jax.Array) for w in leaves):
+        return jnp.concatenate(leaves, axis=-1)
+    if all(isinstance(w, QuantizedTensor) for w in leaves):
+        if len({w.dtype for w in leaves}) != 1:
+            return None
+        return QuantizedTensor(
+            jnp.concatenate([w.q for w in leaves], axis=-1),
+            jnp.concatenate([w.s for w in leaves], axis=-1),
+            leaves[0].dtype)
+    if all(isinstance(w, NF4Tensor) for w in leaves):
+        if (len({w.dtype for w in leaves}) != 1
+                or len({w.in_dim for w in leaves}) != 1):
+            return None
+        return NF4Tensor(
+            jnp.concatenate([w.packed for w in leaves], axis=-1),
+            jnp.concatenate([w.scales for w in leaves], axis=-1),
+            leaves[0].in_dim, leaves[0].dtype)
+    return None
+
+
 def fuse_qkv_layers(layers: Params) -> Params:
     """Return `layers` with wq|wk|wv concatenated into one ``wqkv`` leaf
     (output axis) — an ENGINE-side layout transform applied at construction
-    time, never a storage format: checkpoints, TP sharding, the trainer,
-    and quantized trees keep the canonical split layout. No-ops (returns
-    the input) when the tree is already fused, quantized (QuantizedTensor/
-    NF4 leaves concat nontrivially and the quant path is weight-stream-
-    bound anyway), or has no attention weights."""
+    time, never a storage format: checkpoints, TP sharding, and the
+    trainer keep the canonical split layout. Quantized trees fuse too
+    (`_concat_out_axis` is exact for int8 and NF4) — for the quantized
+    kernels this IS the launch aggregation: three kernel dispatches per
+    layer become one covering all three projections' N tiles. No-ops
+    (returns the input) when the tree is already fused, mixes leaf
+    types, or has no attention weights."""
     if not isinstance(layers, dict) or "attn" not in layers:
         return layers
     attn = layers["attn"]
     if "wq" not in attn:
         return layers
-    if not all(isinstance(attn[k], jax.Array) for k in ("wq", "wk", "wv")):
+    wqkv = _concat_out_axis([attn["wq"], attn["wk"], attn["wv"]])
+    if wqkv is None:
         return layers
     fused = {k: v for k, v in attn.items() if k not in ("wq", "wk", "wv")}
-    fused["wqkv"] = jnp.concatenate(
-        [attn["wq"], attn["wk"], attn["wv"]], axis=-1)
+    fused["wqkv"] = wqkv
     out = dict(layers)
     out["attn"] = fused
     return out
@@ -246,12 +285,13 @@ def fuse_gate_up_layers(layers: Params) -> Params:
     mlp = layers["mlp"]
     if "wg" not in mlp or "wu" not in mlp:
         return layers
-    if not all(isinstance(mlp[k], jax.Array) for k in ("wg", "wu")):
-        return layers
     if "router" in mlp:              # MoE expert weights keep canonical
         return layers
+    wgu = _concat_out_axis([mlp["wg"], mlp["wu"]])
+    if wgu is None:
+        return layers
     fused = {k: v for k, v in mlp.items() if k not in ("wg", "wu")}
-    fused["wgu"] = jnp.concatenate([mlp["wg"], mlp["wu"]], axis=-1)
+    fused["wgu"] = wgu
     out = dict(layers)
     out["mlp"] = fused
     return out

@@ -1,0 +1,148 @@
+"""int8 matmul with the per-channel scale folded into the epilogue (the
+round-7 int8 decode lever).
+
+The round-5 int8 path materialized a full bf16 weight per layer before
+each matmul (``dequant_tree`` -> ``(q * s).astype(bf16)`` -> ``x @ w``):
+HBM sees the int8 read AND the bf16 write+read of the materialized
+weight, which is why int8 decode sat at 0.65 of sustained bandwidth
+while reading half the bytes of bf16. The fix is to never materialize:
+
+    y = (x @ q) * s            # q int8 streams straight into the dot,
+                               # one f32 multiply per OUTPUT element
+
+which is exact per output channel — scaling a column after the
+K-reduction is algebraically identical to scaling the column's weights
+before it; the only difference from the materialize path is floating-
+point accumulation order (the same contract as ops.nf4_kernel).
+
+Two execution paths, selected per shape:
+
+  * Pallas kernel (TPU decode shapes): streams the int8 tile from HBM,
+    widens to the activation dtype in VMEM (|q| <= 127 is exact in
+    bf16), feeds the MXU, applies the scale row to the f32 accumulator
+    before writeback. Grid = N tiles of ONE launch, full-K stripes —
+    the same aggregated-launch layout as ops.nf4_kernel.
+  * XLA mixed-dtype dot (everything else, and all of CPU CI):
+    ``lax.dot_general`` takes an int8 rhs with f32 accumulation
+    directly, so even the fallback never materializes a scaled weight.
+
+`int8_dot` is dispatched from models.transformer._dot when
+models.quant.int8_fold_enabled() leaves 2-D QuantizedTensor leaves
+packed (default ON; INT8_FOLD=0 restores dequant-materialize). Token
+parity with the materialize path is pinned by tests/test_int8_kernel.py
+and the serving parity suites.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..models.quant import QuantizedTensor
+
+TILE_N = 128
+
+# Tests flip this to run the kernel through the Pallas interpreter on the
+# CPU backend (slow, exact semantics) — the kernel itself targets TPU.
+_INTERPRET = False
+
+# Trace-time dispatch counter: incremented once per kernel-path call SITE
+# per trace (under lax.scan the body traces once for all layers), so
+# tests can pin "launch sites per decode step" without running on-chip.
+_launches = 0
+
+
+def _vmem_bytes(m: int, k: int, tn: int, x_bytes: int) -> int:
+    """Per-program VMEM footprint estimate, double-buffered: the x block
+    [m, k], the int8 weight tile [k, tn], its widened copy [k, tn] in the
+    activation dtype, the (sublane-padded) scale row [8, tn] f32, and the
+    out tile [m, tn] f32."""
+    one = (m * k * x_bytes + k * tn + k * tn * x_bytes
+           + 8 * tn * 4 + m * tn * 4)
+    return 2 * one
+
+
+def _tile_n(n: int, k: int, m: int, x_bytes: int) -> int:
+    """Widest N tile that divides N AND fits the VMEM budget — same
+    policy as ops.nf4_kernel._tile_n: wider tiles cut grid steps per
+    launch; the budget guard falls back to 128 rather than fail a shape
+    that used to serve (e.g. a large-K fused wd at a big prefill m)."""
+    budget = 12 * 1024 * 1024          # ~16 MB/core minus headroom
+    for tn in (512, 256):
+        if n % tn == 0 and _vmem_bytes(m, k, tn, x_bytes) <= budget:
+            return tn
+    return TILE_N
+
+
+@functools.lru_cache(maxsize=64)
+def _make_kernel(m: int, k: int, n: int, out_dtype: str,
+                 interpret: bool = False):
+    from jax.experimental import pallas as pl
+
+    tn = _tile_n(n, k, m, jnp.dtype(out_dtype).itemsize)
+
+    def kernel(x_ref, q_ref, s_ref, out_ref):
+        # int32 FIRST (Mosaic has no vector i8->float cast), then the
+        # activation dtype: +-127 is exact in bf16, so the MXU sees the
+        # true int8 values at bf16 feed rate.
+        w = q_ref[:].astype(jnp.int32).astype(x_ref.dtype)
+        acc = jnp.dot(x_ref[:], w, preferred_element_type=jnp.float32)
+        # Scale epilogue: one f32 row [1, tn] broadcast over the m rows
+        # of the accumulator — per OUTPUT element, not per weight.
+        out_ref[:] = (acc * s_ref[:]).astype(out_ref.dtype)
+
+    @jax.jit
+    def fn(x, q, s):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((m, n), jnp.dtype(out_dtype)),
+            grid=(n // tn,),
+            in_specs=[
+                pl.BlockSpec((m, k), lambda j: (0, 0)),
+                pl.BlockSpec((k, tn), lambda j: (0, j)),
+                pl.BlockSpec((1, tn), lambda j: (0, j)),
+            ],
+            out_specs=pl.BlockSpec((m, tn), lambda j: (0, j)),
+            interpret=interpret,
+        )(x, q, s)
+
+    return fn
+
+
+def _supported(m: int, w: QuantizedTensor) -> bool:
+    k, n = w.q.shape[-2], w.q.shape[-1]
+    assert m % 8 == 0, "caller pads rows to a multiple of 8"
+    return (w.q.ndim == 2                 # one layer's weight, not a stack
+            and k % 128 == 0              # x lane dim / q sublane tiling
+            and n % TILE_N == 0
+            and (jax.default_backend() == "tpu" or _INTERPRET))
+
+
+def int8_dot(x: jnp.ndarray, w: QuantizedTensor) -> jnp.ndarray:
+    """x [..., K] @ int8 weight [K, N] (scale folded into the epilogue)
+    -> [..., N] in x.dtype.
+
+    Pallas kernel when the shape qualifies (see `_supported`); XLA
+    mixed-dtype dot_general otherwise — BOTH stream the int8 bytes and
+    scale the accumulator, so enabling the fold never changes which
+    shapes serve and never materializes a scaled weight."""
+    global _launches
+    lead = x.shape[:-1]
+    k = x.shape[-1]
+    x2 = x.reshape(-1, k)
+    m = x2.shape[0]
+    m_pad = -(-max(m, 8) // 8) * 8
+    if _supported(m_pad, w):
+        _launches += 1
+        if m_pad != m:
+            x2 = jnp.pad(x2, ((0, m_pad - m), (0, 0)))
+        fn = _make_kernel(m_pad, k, w.q.shape[-1], str(x.dtype),
+                          interpret=_INTERPRET)
+        out = fn(x2, w.q, w.s.astype(jnp.float32))
+        return out[:m].reshape(*lead, -1)
+    acc = jax.lax.dot_general(
+        x2, w.q, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return (acc * w.s).astype(x.dtype).reshape(*lead, -1)

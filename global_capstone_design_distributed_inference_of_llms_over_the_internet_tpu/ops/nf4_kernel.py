@@ -26,6 +26,20 @@ scales ride as f32 so the scale block's sublane count stays legal, and
 the uint8 block is widened to int32 BEFORE shifting (Mosaic cannot
 legalize vector i8 shrui).
 
+Launch aggregation (round 7): ONE pallas_call already covers all N
+tiles of a weight via the grid, so launches/step = matmul SITES, not
+tiles. The round-5 count (~80/step at M=16: 7 sites x 16 layers
+untamed by scan site-sharing on the per-step path) was dominated by
+quantized trees skipping the engine-side QKV and gate+up fusions —
+`models.transformer._concat_out_axis` now concatenates packed NF4 (and
+int8) leaves exactly, so a layer runs FOUR launches (wqkv, wo, wgu,
+wd), each one `pallas_call` whose grid walks the fused weight's full N
+extent, and under `lax.scan` those four SITES serve every layer of the
+step. Cross-layer aggregation into a single launch is structurally
+impossible — attention and norms sit between the matmuls — so 4 sites
+is the floor for this architecture, pinned (with the `_launches`
+counter below) by the launch-count guard in tests/test_burst.py.
+
 `nf4_dot` is the dispatch wrapper used by the model's matmul sites when
 `NF4_KERNEL=1` (utils env flag): it falls back to dequant-then-matmul
 for any shape the kernel does not cover, so enabling the flag can never
@@ -51,6 +65,11 @@ TILE_N = 128
 # Tests flip this to run the kernel through the Pallas interpreter on the
 # CPU backend (slow, exact semantics) — the kernel itself targets TPU.
 _INTERPRET = False
+
+# Trace-time dispatch counter: incremented once per kernel-path call SITE
+# per trace (under lax.scan the body traces once for all layers), so
+# tests can pin "launch sites per decode step" without running on-chip.
+_launches = 0
 
 
 def _vmem_bytes(m: int, p: int, sb: int, tn: int, x_bytes: int) -> int:
@@ -144,12 +163,14 @@ def nf4_dot(x: jnp.ndarray, w: NF4Tensor) -> jnp.ndarray:
     Kernel path when the shape qualifies (see `_supported`); exact
     dequant-then-matmul fallback otherwise — enabling the kernel never
     changes which shapes serve."""
+    global _launches
     lead = x.shape[:-1]
     k = x.shape[-1]
     x2 = x.reshape(-1, k)
     m = x2.shape[0]
     m_pad = -(-max(m, 8) // 8) * 8
     if _supported(m_pad, w):
+        _launches += 1
         if m_pad != m:
             x2 = jnp.pad(x2, ((0, m_pad - m), (0, 0)))
         fn = _make_kernel(m_pad, k, w.packed.shape[-1], str(x.dtype),

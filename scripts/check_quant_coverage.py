@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Fail (exit 1) when a quant format ships without bench + parity coverage.
+
+Every format listed in ``models/quant.py::QUANT_BITS`` (except "none",
+the unquantized baseline every row already is) must have:
+
+  * a bench row: a ``quantize_params(..., "<fmt>")`` call (or the
+    ``_qp(..., "<fmt>")`` alias) inside bench.py, so regressions in the
+    format's serving path surface in ``BENCH_*`` numbers;
+  * a parity test: a ``"<fmt>"`` quantize under tests/ whose module
+    asserts token equality against a dequantized/materialized reference
+    (grepped as a quantize call in a tests/test_*.py file that also
+    contains a parity-style assertion).
+
+The format list is read from quant.py's SOURCE TEXT (regex, no import):
+quant.py pulls in jax at import time and this check must stay cheap
+enough to run as a tier-1 test (tests/test_quant_coverage.py).
+"""
+
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+QUANT = (REPO / "global_capstone_design_distributed_inference_of_llms"
+         "_over_the_internet_tpu" / "models" / "quant.py")
+BENCH = REPO / "bench.py"
+TESTS = sorted((REPO / "tests").glob("test_*.py"))
+
+
+def quant_formats(src: str) -> list:
+    m = re.search(r"QUANT_BITS\s*=\s*\{(.*?)\}", src, re.S)
+    if not m:
+        print(f"could not find QUANT_BITS in {QUANT.relative_to(REPO)}")
+        sys.exit(2)
+    fmts = re.findall(r'"([a-z0-9_]+)"\s*:', m.group(1))
+    return [f for f in fmts if f != "none"]
+
+
+_CALL = r"(?:quantize_params|quantize_layers|_qp|_sqp)"
+# Call args with one level of paren nesting allowed before the mode string
+# (e.g. quantize_params(slice_stage_params(cfg, params, spec), "nf4")).
+_ARGS = r"\((?:[^()]|\([^()]*\))*?"
+
+
+def _quantize_calls(text: str, fmts) -> set:
+    # quantize_params(x, "fmt") / quantize_layers(x, "fmt") and the local
+    # aliases bench.py uses (_qp/_sqp). Mode omitted means int8 (the
+    # signature default).
+    called = {f for f in fmts
+              if re.search(_CALL + _ARGS + '"%s"' % re.escape(f), text)}
+    if re.search(_CALL + r'\(\s*[a-zA-Z_][^,")]*\)', text):
+        called.add("int8")
+    return called
+
+
+def main() -> int:
+    fmts = quant_formats(QUANT.read_text(encoding="utf-8"))
+    bench_cov = _quantize_calls(BENCH.read_text(encoding="utf-8"), fmts)
+    parity_cov = set()
+    for p in TESTS:
+        text = p.read_text(encoding="utf-8")
+        # A parity module compares quantized serving against a dequantized
+        # or materialized reference by exact equality.
+        if not re.search(r"dequant|materializ", text):
+            continue
+        if not re.search(r"assert .*==|assert_array_equal", text):
+            continue
+        parity_cov |= _quantize_calls(text, fmts)
+    failed = False
+    for fmt in fmts:
+        missing = []
+        if fmt not in bench_cov:
+            missing.append("bench row in bench.py")
+        if fmt not in parity_cov:
+            missing.append("parity test under tests/")
+        if missing:
+            failed = True
+            print(f"quant format {fmt!r} (models/quant.py QUANT_BITS) "
+                  f"lacks: {', '.join(missing)}")
+    if not failed:
+        print(f"ok: all {len(fmts)} quant formats have bench rows and "
+              f"parity tests")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

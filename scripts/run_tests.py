@@ -55,17 +55,17 @@ SHARDS = [
      "test_cli_modes_documented.py", "test_paged_attention.py"],
     # 2: distributed bring-up + elastic serving
     ["test_dcn.py", "test_elastic_server.py", "test_finetune.py",
-     "test_fused_decode.py", "test_ici_pipeline.py", "test_kv_cache.py",
-     "test_load_balancing.py"],
+     "test_fused_decode.py", "test_ici_pipeline.py", "test_int8_kernel.py",
+     "test_kv_cache.py", "test_load_balancing.py"],
     # 3: oracles + registry + wire
     ["test_metrics_documented.py", "test_models_oracle.py",
      "test_multi_model.py", "test_net.py", "test_no_bare_print.py",
      "test_offload.py", "test_partition.py", "test_registry_ha.py"],
     # 4: protocol extensions
     ["test_push_chain.py", "test_nf4_kernel.py", "test_prefix_cache.py",
-     "test_quant.py", "test_quarantine_hook.py", "test_remote_store.py",
-     "test_ring_attention.py", "test_ring_decode.py",
-     "test_routing_rtt.py"],
+     "test_quant.py", "test_quant_coverage.py", "test_quarantine_hook.py",
+     "test_remote_store.py", "test_ring_attention.py",
+     "test_ring_decode.py", "test_routing_rtt.py"],
     # 5: pipeline runtime + serving engines
     ["test_runtime_pipeline.py", "test_serve_batched.py",
      "test_serve_sp.py", "test_serve_tp.py", "test_serving.py",

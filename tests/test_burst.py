@@ -370,3 +370,73 @@ def test_bench_serving_burst_smoke(cfg, params):
     assert 0 < r["dispatches_per_token"] < 1.0
     assert r["tokens_per_dispatch"] > 1.0
     assert r["tokens_per_s_colocated_est"] >= r["tokens_per_s"] * 0.99
+
+
+# -- quantized burst serving: parity + launch-count guard ---------------------
+
+@pytest.mark.parity
+@pytest.mark.parametrize("mode", ["int8", "nf4"])
+def test_burst_engine_quantized_matches_dequantized(cfg, params, mode):
+    """The burst path over a quantized tree (int8 rides the default
+    scale-folded epilogue; nf4 the select-tree dequant on CPU) emits
+    tokens IDENTICAL to the burst path over the explicitly materialized
+    weights — quantization error lives in the weights, never in the
+    burst execution."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.quant import (
+        dequant_tree,
+        quantize_params,
+    )
+
+    qparams = quantize_params(params, mode)
+    dparams = dequant_tree(qparams)       # stacked 3-D: fully materialized
+    got, _ = _bursty(cfg, qparams, PROMPTS, GREEDY, seed=0, max_new=10,
+                     n_ticks=4)
+    ref, _ = _bursty(cfg, dparams, PROMPTS, GREEDY, seed=0, max_new=10,
+                     n_ticks=4)
+    for sid in PROMPTS:
+        assert got[sid] == ref[sid], (mode, sid, got[sid], ref[sid])
+
+
+@pytest.mark.parity
+def test_nf4_kernel_launch_count_guard(monkeypatch):
+    """Launch aggregation pinned: with NF4_KERNEL=1 on a kernel-eligible
+    shape, ONE N-tick burst traces at most FOUR pallas_call sites (wqkv,
+    wo, wgu, wd — the engine-fused layout; lax.scan shares them across
+    layers and ticks), and an already-compiled burst dispatches ZERO new
+    launches. This is the structural floor: attention and norms sit
+    between the matmuls, so per-layer sites cannot merge further."""
+    import global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.ops.nf4_kernel as NK
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+        init_params,
+        llama_config,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.quant import (
+        quantize_params,
+    )
+
+    monkeypatch.setattr(NK, "_INTERPRET", True)
+    monkeypatch.setenv("NF4_KERNEL", "1")
+    kcfg = llama_config(vocab_size=128, hidden_size=128, num_layers=2,
+                        num_heads=4, num_kv_heads=2, intermediate_size=256,
+                        max_position_embeddings=32)
+    qp = quantize_params(init_params(jax.random.PRNGKey(0), kcfg), "nf4")
+    ex = BatchedStageExecutor(kcfg, _full_spec(kcfg), qp, slots=2,
+                              max_len=16)
+    # The fused layout is what makes 4 the bound (7 canonical sites).
+    assert "wqkv" in ex.params["layers"]["attn"]
+    assert "wgu" in ex.params["layers"]["mlp"]
+    h = ex.prefill("s", np.asarray([[3, 5, 7]], np.int32))
+    tok = int(jnp.argmax(ex.logits(h[:, -1:])[0, -1]))
+    monkeypatch.setattr(NK, "_launches", 0)
+
+    def burst(t):
+        return ex.decode_burst({"s": {
+            "token": t, "seed": 0, "budget": 4, "eos": None,
+            "generated": (t,), "temperature": 0.0, "top_p": 1.0,
+            "top_k": 0, "repetition_penalty": 1.0}}, 2)
+
+    res = burst(tok)
+    assert NK._launches <= 4, NK._launches   # one trace, four sites
+    first = NK._launches
+    burst(int(res["s"]["tokens"][-1]))
+    assert NK._launches == first             # cached program: zero new

@@ -1,0 +1,21 @@
+"""Tier-1 wrapper for scripts/check_quant_coverage.py: every quant format
+in models/quant.py::QUANT_BITS must have a bench row in bench.py and a
+token-parity test under tests/ — a new format cannot ship benchmarked-
+but-unverified or verified-but-unmeasured."""
+
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_every_quant_format_has_bench_and_parity():
+    proc = subprocess.run(
+        [sys.executable,
+         str(REPO / "scripts" / "check_quant_coverage.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"quant coverage drift:\n{proc.stdout}{proc.stderr}"
+    )
