@@ -602,6 +602,41 @@ def bench_serving_burst(cfg, params, *, slots=8, max_len=512, prefill=64,
     tb1, tb2 = t1 / max(d1, 1), t2 / max(d2, 1)
     per_session = max(0.0, (tb2 - tb1) / (slots - n1))
     fixed = max(tb2 - slots * per_session, 1e-6)
+
+    # One more full-slot stream OUTSIDE the clock with the phase profiler
+    # on: the timed reps above keep the dispatch/readback overlap intact;
+    # this pass trades the overlap for a breakdown (the device phase fences
+    # each burst — docs/OBSERVABILITY.md). Mean per-burst ms per phase plus
+    # the device bubble fraction ride the row as dispatch_ms / device_ms /
+    # readback_ms / bubble_frac.
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.telemetry.profiling import (
+        disable_phase_profiling,
+        enable_phase_profiling,
+        get_profiler,
+    )
+    enable_phase_profiling()
+    prof = get_profiler()
+    prof.reset()
+    try:
+        rng = np.random.default_rng(reps)
+        toks = {}
+        for s in range(slots):
+            prompt = rng.integers(0, cfg.vocab_size, prefill,
+                                  dtype=np.int32)
+            h = ex.prefill(f"s{s}", prompt[None, :])
+            toks[f"s{s}"] = int(jnp.argmax(ex.logits(h[:, -1:])[0, -1]))
+        for _ in ex.burst_stream(make_entries(toks), burst):
+            pass
+        snap = prof.snapshot()
+        bubble = prof.bubble_fraction()
+    finally:
+        disable_phase_profiling()
+        prof.reset()
+
+    def _phase_ms(name):
+        st = snap.get(name)
+        return round(st["mean_s"] * 1e3, 3) if st else 0.0
+
     return {
         "tokens_per_s": round(k2 / t2, 2),
         "dispatches_per_token": round(d2 / max(k2, 1), 5),
@@ -612,6 +647,10 @@ def bench_serving_burst(cfg, params, *, slots=8, max_len=512, prefill=64,
         "burst_ms_colocated_est": round(fixed * 1e3, 3),
         "tokens_per_s_colocated_est": round((k2 / max(d2, 1)) / fixed, 2),
         "slots": slots, "max_len": max_len,
+        "dispatch_ms": _phase_ms("dispatch"),
+        "device_ms": _phase_ms("device"),
+        "readback_ms": _phase_ms("readback"),
+        "bubble_frac": round(bubble, 4),
         "note": "burst_stream drives one jitted lax.scan dispatch per "
                 f"{burst} ticks with the next burst in flight during "
                 "readback, so the tunnel's per-dispatch cost is amortized "
@@ -1371,6 +1410,79 @@ def bench_recorder_overhead(step_ms_ref: float, iters=20000, reps=5):
     }
 
 
+def bench_profiler_overhead(step_ms_ref: float, iters=20000, reps=5):
+    """Phase-profiler acceptance row: the hot path's bracket sequence must
+    cost <2% of a fused decode step, disabled AND enabled.
+
+    A profiled burst pays five phase brackets (burst_build, dispatch,
+    readback on the engine; socket and server per hop) plus one
+    ``device_interval`` per dispatch — this times exactly that sequence
+    against a private PhaseProfiler in both states: disabled (the default —
+    one attribute check returning the shared no-op bracket) and enabled
+    (perf_counter pairs, the locked aggregate, and the histogram mirror).
+    The bound is <2% rather than telemetry's <1% because a bracket is two
+    clock reads plus a lock where a counter inc is one unlocked add; the
+    number deliberately EXCLUDES the dispatch-overlap fidelity trade the
+    device phase makes when profiling is on, which dominates in practice
+    and is already priced by the serving_burst row's profiled pass."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.telemetry.profiling import (
+        PhaseProfiler,
+    )
+
+    def build(enabled: bool):
+        reg = MetricsRegistry(enabled=enabled)
+        prof = PhaseProfiler(enabled=enabled, registry=reg)
+        clock = [0.0]
+
+        def one_step():
+            with prof.phase("burst_build"):
+                pass
+            with prof.phase("dispatch"):
+                pass
+            with prof.phase("socket"):
+                pass
+            with prof.phase("server"):
+                pass
+            with prof.phase("readback"):
+                pass
+            t0 = clock[0]
+            clock[0] = t0 + 0.004
+            prof.device_interval(t0, clock[0])
+
+        return one_step
+
+    def time_it(fn):
+        fn()  # warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fn()
+            best = min(best, time.perf_counter() - t0)
+        return best / iters
+
+    t_off = time_it(build(False))
+    t_on = time_it(build(True))
+    ref_s = step_ms_ref / 1e3
+    return {
+        "brackets_per_step": 5,
+        "device_intervals_per_step": 1,
+        "disabled_us_per_step": round(t_off * 1e6, 3),
+        "enabled_us_per_step": round(t_on * 1e6, 3),
+        "fused_step_ms_ref": round(step_ms_ref, 3),
+        "overhead_pct_disabled": round(t_off / ref_s * 100, 4),
+        "overhead_pct_enabled": round(t_on / ref_s * 100, 4),
+        "pass_lt_2pct_disabled": bool(t_off / ref_s < 0.02),
+        "pass_lt_2pct_enabled": bool(t_on / ref_s < 0.02),
+        "note": ("host-side microbench of one burst's full bracket "
+                 "sequence (5 phase brackets + 1 device interval), "
+                 "disabled (default) vs enabled (--profile_phases), "
+                 "priced against the measured fused step; excludes the "
+                 "device-fence overlap cost, which is a fidelity trade "
+                 "rather than bracket overhead"),
+    }
+
+
 def _device_reachable(timeout_s: float = 90.0) -> bool:
     """Probe backend init in a SUBPROCESS: a wedged axon tunnel hangs
     jax.devices() indefinitely, which would turn the driver's bench run
@@ -1572,6 +1684,7 @@ def main():
         rpd = bench_prefix_digest(cfg, seq=128, grain=64, reps=3)
         rt = bench_telemetry_overhead(r["step_ms"])
         rrec = bench_recorder_overhead(r["step_ms"])
+        rprof = bench_profiler_overhead(r["step_ms"])
         try:
             rgw = bench_gateway(cfg, params, splits=(2,), n_requests=4,
                                 max_new_tokens=4)
@@ -1583,6 +1696,7 @@ def main():
                 "smoke_prefix_cache": rpx, "smoke_prefix_digest": rpd,
                 "smoke_telemetry_overhead": rt,
                 "smoke_recorder_overhead": rrec,
+                "smoke_profiling": rprof,
                 "smoke_gateway": rgw}
         print(json.dumps({"metric": "smoke", "value": r["tokens_per_s"],
                           "unit": "tokens/s", "vs_baseline": 1.0,
@@ -1860,6 +1974,14 @@ def main():
             results["flagship_1b_b16"]["step_ms"])
     except Exception as exc:
         results["recorder_overhead"] = {"error": str(exc)[:200]}
+
+    # ISSUE 9 acceptance: the phase profiler's bracket sequence <2% of a
+    # fused decode step (the dashboard must not tax the path it meters).
+    try:
+        results["profiler_overhead"] = bench_profiler_overhead(
+            results["flagship_1b_b16"]["step_ms"])
+    except Exception as exc:
+        results["profiler_overhead"] = {"error": str(exc)[:200]}
 
     primary = results["flagship_1b_b16"]
 

@@ -1005,7 +1005,17 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
         # the seed registry while it's up, the mirror/stale snapshot after.
         return [r.address for r in registry.live_servers() if r.address]
 
-    gloop = GossipLoop(gnode, _gx, record_fn=lambda: _r2d(rec),
+    from .telemetry.profiling import stats_digest as _stats_digest
+
+    def _own_rec_with_stats():
+        # Piggyback this server's live stats digest on the gossip record:
+        # dict_to_rec ignores unknown keys, so the "stats" extra propagates
+        # swarm-wide verbatim and --mode top reads it from ANY live mirror.
+        d = _r2d(rec)
+        d["stats"] = _stats_digest()
+        return d
+
+    gloop = GossipLoop(gnode, _gx, record_fn=_own_rec_with_stats,
                        extra_peers_fn=_seed_peers)
     gloop.start()
     _emit(f"SERVING stage={args.stage} span=[{spec.start},{spec.end}) "
@@ -1138,9 +1148,15 @@ def _run_serve_elastic(args, cfg: ModelConfig, params) -> int:
     from .runtime.net import gossip_exchange as _gx
     from .scheduling.registry import rec_to_dict as _r2d
 
+    from .telemetry.profiling import stats_digest as _stats_digest
+
     def _own_record():
         # During a re-span the spec is momentarily unset; skip that beat.
-        return _r2d(es._record()) if es.spec is not None else None
+        if es.spec is None:
+            return None
+        d = _r2d(es._record())
+        d["stats"] = _stats_digest()
+        return d
 
     gloop = GossipLoop(
         gnode, _gx, record_fn=_own_record,
@@ -2137,8 +2153,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mode",
                    choices=["local", "fused", "oracle",
                             "registry", "serve", "client", "status",
-                            "metrics", "doctor", "dcn-check", "chaos",
-                            "gateway", "submit"],
+                            "metrics", "doctor", "top", "dcn-check",
+                            "chaos", "gateway", "submit"],
                    default="local")
     p.add_argument("--telemetry", action="store_true",
                    help="enable the process-global metrics registry, "
@@ -2159,6 +2175,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "(--events-dump output) to diagnose; omit to "
                         "scrape LIVE servers' event rings via the "
                         "registry instead")
+    p.add_argument("--critical_path", action="store_true",
+                   help="doctor mode: also assemble the client/server "
+                        "spans embedded in the dumps into per-request "
+                        "span trees and report the critical path, with "
+                        "wall time attributed to network / queue / "
+                        "compute / replay / client (the parts sum to each "
+                        "request's wall time). Needs dumps from runs with "
+                        "--telemetry.")
+    p.add_argument("--once", action="store_true",
+                   help="top mode: render one snapshot and exit instead "
+                        "of refreshing (scripting / tests)")
+    p.add_argument("--top_interval", type=float, default=2.0,
+                   help="top mode: seconds between refreshes")
     p.add_argument("--log-json", dest="log_json", action="store_true",
                    help="emit every log record as one JSON object per "
                         "line (machine-ingestable) instead of the "
@@ -2390,6 +2419,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run to DIR "
                         "(view with TensorBoard / Perfetto)")
+    p.add_argument("--profile_phases", action="store_true",
+                   help="enable the host-side phase profiler: per-phase "
+                        "latency histograms (server_phase_seconds) over "
+                        "the serving hot path and the device "
+                        "bubble-fraction gauge "
+                        "(server_device_bubble_ratio). Adds a fence per "
+                        "collected burst; default off so the hot path "
+                        "pays only a boolean check.")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -2642,7 +2679,11 @@ def run_doctor(args) -> int:
             _emit("error: dump file(s) not found: " + ", ".join(missing),
                   file=sys.stderr)
             return 1
-        _emit(_doc.diagnose(paths), end="")
+        streams = _doc.load_dumps(paths)
+        _emit(_doc.diagnose_streams(streams), end="")
+        if args.critical_path:
+            _emit(_doc.render_critical_path(
+                _doc.critical_path_reports(streams)), end="")
         return 0
 
     from .runtime.net import RemoteRegistry, TcpTransport
@@ -2669,7 +2710,149 @@ def run_doctor(args) -> int:
               "--telemetry or --events-dump?)")
         return 1
     _emit(_doc.diagnose_streams(streams), end="")
+    if args.critical_path:
+        _emit(_doc.render_critical_path(
+            _doc.critical_path_reports(streams)), end="")
     return 0
+
+
+def _render_top(rows: list, source: str, gateway: Optional[dict]) -> str:
+    """One ``--mode top`` frame: a whole-swarm stats table plus (when a
+    gateway answered) per-tenant SLO burn rates."""
+    lines = [f"swarm top — {len(rows)} server(s) (source: {source})"]
+    hdr = (f"{'PEER':<14} {'SPAN':<10} {'TOK/S':>8} {'QUEUE':>6} "
+           f"{'BRK':>4} {'CACHE%':>7} {'BUBBLE%':>8} {'UP(S)':>8}")
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+
+    def _f(stats, key, scale=1.0, fmt="{:.1f}", dash="-"):
+        v = (stats or {}).get(key)
+        if v is None:
+            return dash
+        try:
+            return fmt.format(float(v) * scale)
+        except (TypeError, ValueError):
+            return dash
+
+    for row in sorted(rows, key=lambda r: (r.get("start_block", 0) or 0,
+                                           str(r.get("peer_id")))):
+        stats = row.get("stats")
+        span = f"[{row.get('start_block', '?')},{row.get('end_block', '?')})"
+        lines.append(
+            f"{str(row.get('peer_id', '?')):<14} {span:<10} "
+            f"{_f(stats, 'tok_s'):>8} "
+            f"{_f(stats, 'queue_depth', fmt='{:.0f}'):>6} "
+            f"{_f(stats, 'breaker_open', fmt='{:.0f}'):>4} "
+            f"{_f(stats, 'cache_hit_ratio', 100.0):>7} "
+            f"{_f(stats, 'bubble_frac', 100.0):>8} "
+            f"{_f(stats, 'uptime_s', fmt='{:.0f}'):>8}")
+    if gateway is not None:
+        lines.append("")
+        lines.append(f"gateway: queue={gateway.get('queue_depth', '?')} "
+                     f"active={gateway.get('active_sessions', '?')} "
+                     f"started={gateway.get('sessions_started', '?')}")
+        slo = gateway.get("slo") or {}
+        for tenant in sorted(slo):
+            parts = ", ".join(
+                f"{obj} burn={rate:.2f}"
+                for obj, rate in sorted(slo[tenant].items()))
+            lines.append(f"  slo {tenant}: {parts or 'no objectives'}")
+    return "\n".join(lines) + "\n"
+
+
+def _collect_top(args) -> Tuple[list, str, Optional[dict]]:
+    """Gather one top-frame's data: per-server record+stats rows, the
+    source description, and the gateway info dict (None if unreachable).
+
+    Stats come gossip-first: dial any live server's ``swarm-stats`` verb
+    and read the piggybacked digests off its mirror — that works with
+    every seed registry dead (records then come from the mirror or the
+    peers cache). Rows whose gossip record carries no digest fall back to
+    a direct per-peer scrape."""
+    from .runtime.net import RemoteRegistry, TcpTransport
+    from .scheduling.registry import PlacementRegistry as _PR
+
+    registry = RemoteRegistry(args.registry_addr, peers_cache=args.peers_cache)
+    records = registry.live_servers(model=args.model_name)
+    rows: dict = {}
+    for r in records:
+        d = {"peer_id": r.peer_id, "address": r.address,
+             "start_block": r.start_block, "end_block": r.end_block,
+             "stats": None}
+        rows[r.peer_id] = d
+    snap = _PR()
+    for r in records:
+        snap.register(r)
+    tx = TcpTransport(snap, wire_dtype=args.wire_dtype)
+    source = "registry (no stats publisher reachable)"
+    try:
+        # Any ONE live server's mirror carries the whole swarm's digests.
+        for r in records:
+            if not r.address:
+                continue
+            try:
+                view = tx.swarm_stats(r.peer_id, timeout=3.0)
+            except Exception:  # noqa: BLE001 — try the next peer
+                continue
+            source = f"gossip via {view.get('peer_id', r.peer_id)}"
+            for rec in view.get("records") or ():
+                pid = rec.get("peer_id")
+                if not pid:
+                    continue
+                row = rows.setdefault(pid, {"peer_id": pid, "stats": None})
+                row.setdefault("address", rec.get("address"))
+                row["start_block"] = rec.get("start_block",
+                                             row.get("start_block"))
+                row["end_block"] = rec.get("end_block", row.get("end_block"))
+                if isinstance(rec.get("stats"), dict):
+                    row["stats"] = rec["stats"]
+            # The answering peer's own digest is fresher than its
+            # (heartbeat-cadence) gossip record.
+            if r.peer_id in rows and isinstance(view.get("self"), dict):
+                rows[r.peer_id]["stats"] = view["self"]
+            break
+        # Direct-scrape fallback for rows gossip had no digest for.
+        for row in rows.values():
+            if row["stats"] is None and row.get("address"):
+                try:
+                    row["stats"] = tx.swarm_stats(
+                        row["peer_id"], timeout=3.0).get("self")
+                except Exception:  # noqa: BLE001 — leave the dashes
+                    pass
+    finally:
+        tx.close()
+
+    gateway = None
+    if args.gateway_addr:
+        from .serving.gateway import GatewaySubmitClient
+        try:
+            gateway = GatewaySubmitClient(args.gateway_addr,
+                                          connect_timeout=1.0).info(
+                                              timeout=2.0)
+        except Exception:  # noqa: BLE001 — no gateway running is normal
+            gateway = None
+    return list(rows.values()), source, gateway
+
+
+def run_top(args) -> int:
+    """Live whole-swarm dashboard (``--mode top``): per-server tok/s,
+    queue depth, breaker state, cache hit rate, device bubble fraction —
+    fed by the stats digests servers piggyback on their gossip records, so
+    it keeps working with every seed registry dead. ``--once`` renders a
+    single frame (tests/scripts); otherwise refreshes every
+    ``--top_interval`` seconds until interrupted."""
+    while True:
+        rows, source, gateway = _collect_top(args)
+        if not rows:
+            _emit("no live servers (and no usable peers cache)")
+            return 1
+        _emit(_render_top(rows, source, gateway), end="", flush=True)
+        if args.once:
+            return 0
+        try:
+            time.sleep(max(0.1, args.top_interval))
+        except KeyboardInterrupt:
+            return 0
 
 
 def run_dcn_check(args) -> int:
@@ -2712,6 +2895,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from . import telemetry
 
         telemetry.enable()
+    if args.profile_phases:
+        # After the telemetry flip so the phase histograms land in the
+        # (now-enabled) process registry; works standalone too — the
+        # profiler keeps its own per-phase stats and bubble accounting.
+        from .telemetry.profiling import enable_phase_profiling
+
+        enable_phase_profiling()
     if args.events_dump:
         # --events-dump alone still records: flip just the recorder (the
         # metrics registry stays off unless --telemetry asked for it) and
@@ -2743,6 +2933,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_metrics(args)  # no model needed
     if args.mode == "doctor":
         return run_doctor(args)  # no model needed
+    if args.mode == "top":
+        return run_top(args)  # no model needed
     if args.mode == "submit":
         return run_submit(args)  # no weights: tokenizer + preset cfg only
     cfg, params = load_model(args)

@@ -64,6 +64,7 @@ from ..ops.rotary import apply_rope
 from ..parallel.ring_attention import NEG_INF
 from ..telemetry import catalog as _tm
 from ..telemetry import events as _ev
+from ..telemetry.profiling import get_profiler as _get_profiler
 from .kv_cache import round_to_bucket
 
 Params = Dict[str, Any]
@@ -920,9 +921,20 @@ class BatchedStageExecutor:
         None/"eos"/"repeat". Sessions join/leave only between bursts."""
         if not entries:
             return {}
-        rows, args = self._burst_prep(entries, n_ticks)
-        fn = self._get_burst_jit(n_ticks)
-        out = fn(self.params, *args, self.k, self.v)
+        prof = _get_profiler()
+        with prof.phase("burst_build"):
+            rows, args = self._burst_prep(entries, n_ticks)
+            fn = self._get_burst_jit(n_ticks)
+        if prof.enabled:
+            # Fenced dispatch: the device phase is dispatch-to-ready, the
+            # bubble gauge charges idle time between successive readies.
+            t_d = time.perf_counter()
+            out = fn(self.params, *args, self.k, self.v)
+            prof.observe("dispatch", time.perf_counter() - t_d)
+            jax.block_until_ready(out)
+            prof.device_interval(t_d, time.perf_counter())
+        else:
+            out = fn(self.params, *args, self.k, self.v)
         toks, stop = out[0], out[1]
         lengths_new = out[3]
         self.k, self.v = out[-2], out[-1]
@@ -930,7 +942,8 @@ class BatchedStageExecutor:
         self.burst_dispatches += 1
         self._m_burst_disp.inc()
         self._m_burst_ticks.observe(n_ticks)
-        return self._burst_collect(rows, toks, stop, lengths_new)
+        with prof.phase("readback"):
+            return self._burst_collect(rows, toks, stop, lengths_new)
 
     def burst_stream(self, entries: Dict[str, dict], n_ticks: int):
         """Double-buffered burst driver (generator): every carry — tokens,
@@ -943,8 +956,10 @@ class BatchedStageExecutor:
         resident cohort; the wire path uses per-burst ``decode_burst``."""
         if not entries:
             return
-        rows, args = self._burst_prep(entries, n_ticks)
-        fn = self._get_burst_jit(n_ticks)
+        prof = _get_profiler()
+        with prof.phase("burst_build"):
+            rows, args = self._burst_prep(entries, n_ticks)
+            fn = self._get_burst_jit(n_ticks)
         remaining = {sid: int(e["budget"]) for sid, e in entries.items()}
         finished: Dict[str, bool] = {sid: False for sid in entries}
         # _burst_prep clamps the ``left`` counter to ONE burst's ticks (the
@@ -966,7 +981,10 @@ class BatchedStageExecutor:
         done = False
         while not done or pending:
             if not done:
+                t_d = time.perf_counter() if prof.enabled else None
                 out = fn(self.params, *carry, *static, self.k, self.v)
+                if t_d is not None:
+                    prof.observe("dispatch", time.perf_counter() - t_d)
                 toks, stop = out[0], out[1]
                 carry = out[2:10]
                 self.k, self.v = out[-2], out[-1]
@@ -976,11 +994,24 @@ class BatchedStageExecutor:
                 self._m_burst_ticks.observe(n_ticks)
                 # out[3] is the post-burst lengths (device array, not yet
                 # read back — _burst_collect does the sync).
-                pending.append((toks, stop, out[3]))
+                pending.append((toks, stop, out[3], t_d))
             # Keep exactly one burst in flight: read back the OLDEST burst
             # only once a newer one has been dispatched (or we are done).
             while pending and (done or len(pending) > 1):
-                block = self._burst_collect(rows, *pending.pop(0))
+                toks_p, stop_p, len_p, t_d = pending.pop(0)
+                if t_d is not None and prof.enabled:
+                    # Fence device completion apart from the host-side
+                    # readback: the fenced burst is the one being collected
+                    # anyway, so dispatch overlap is preserved — overlapped
+                    # dispatches show up as zero bubble, host stalls between
+                    # readies as idle device time.
+                    jax.block_until_ready((toks_p, stop_p, len_p))
+                    t_r = time.perf_counter()
+                    prof.device_interval(t_d, t_r)
+                    block = self._burst_collect(rows, toks_p, stop_p, len_p)
+                    prof.observe("readback", time.perf_counter() - t_r)
+                else:
+                    block = self._burst_collect(rows, toks_p, stop_p, len_p)
                 live = {}
                 for sid, res in block.items():
                     m = len(res["tokens"])

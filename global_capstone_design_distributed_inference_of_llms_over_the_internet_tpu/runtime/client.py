@@ -44,6 +44,7 @@ from ..scheduling.registry import PlacementRegistry, ServerRecord
 from ..telemetry import MetricsRegistry, get_tracer
 from ..telemetry import catalog as _tm
 from ..telemetry import events as _ev
+from ..telemetry.profiling import get_profiler as _get_profiler
 from .executor import StageExecutionError, StageExecutor
 from .messages import StageRequest, StageResponse, clip_generated
 from .transport import DeadlineExceeded, PeerUnavailable, Transport
@@ -754,8 +755,11 @@ class PipelineClient:
                 if not self.breaker.allow(hop.peer_id):
                     raise _BreakerOpen(
                         f"peer {hop.peer_id}: circuit breaker open")
-                resp = self.transport.call(hop.peer_id, req,
-                                           timeout=self.request_timeout)
+                # The "socket" phase: one request/response turnaround on
+                # the wire, per attempt (recovery machinery stays outside).
+                with _get_profiler().phase("socket"):
+                    resp = self.transport.call(hop.peer_id, req,
+                                               timeout=self.request_timeout)
                 self.breaker.record_success(hop.peer_id)
                 return resp
             except DeadlineExceeded:

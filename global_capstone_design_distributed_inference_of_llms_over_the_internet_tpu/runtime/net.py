@@ -58,6 +58,8 @@ from ..telemetry import events as _ev
 from ..telemetry import exposition as _texp
 from ..telemetry import get_registry as _get_metrics_registry
 from ..telemetry import get_tracer
+from ..telemetry.profiling import get_profiler as _get_profiler
+from ..telemetry.profiling import stats_digest as _prof_digest
 from .executor import StageExecutionError, StageExecutor
 from .faults import SITE_KINDS, FaultPlan, FaultSocket
 from .messages import BackwardRequest, StageRequest, StageResponse
@@ -839,6 +841,20 @@ class TcpStageServer(_FramedTcpServer):
             # takes plans) and gated by allow_fault_injection.
             _send_frame(sock, self._fault_admin(header))
             return
+        if verb == "swarm-stats":
+            # Swarm-top scrape: this process's own stats digest plus every
+            # live gossip record it holds (verbatim, so piggybacked per-peer
+            # "stats" digests ride along). Executor-less and registry-free:
+            # dialing ANY live server yields a whole-swarm view even with
+            # every seed registry dead.
+            _send_frame(sock, {
+                "verb": "swarm-stats",
+                "peer_id": self.peer_id or "?",
+                "self": _prof_digest(),
+                "records": (self.gossip.live_records()
+                            if self.gossip is not None else []),
+            })
+            return
         if self.gossip is not None and verb in (
                 "gossip", "register", "heartbeat", "unregister", "list"):
             # Control-plane mirror: executor-less on purpose — a
@@ -1094,6 +1110,7 @@ class TcpStageServer(_FramedTcpServer):
             step_timeout = (remaining if step_timeout is None
                             else min(step_timeout, remaining))
 
+        t_compute = time.monotonic()
         try:
             resp = self._compute("inference", ex.forward, req,
                                  size=req.seq_len, timeout=step_timeout,
@@ -1140,7 +1157,11 @@ class TcpStageServer(_FramedTcpServer):
             return
         # End the server span at compute completion (its to_wire summary
         # rides the response so the CLIENT records both sides of the hop).
-        span.set(cache_len=resp.cache_len).end()
+        # queue_s here is the pre-dispatch wait at this boundary (deadline
+        # checks); pool queueing is inside _compute and charges to compute.
+        _get_profiler().observe("server", time.monotonic() - t_req)
+        span.set(cache_len=resp.cache_len,
+                 queue_s=max(0.0, t_compute - t_req)).end()
         wire_span = span.to_wire() if req.trace is not None else None
         if getattr(resp, "is_burst", False):
             frame = {
@@ -1913,6 +1934,23 @@ class TcpTransport(Transport):
             raise WireError(
                 f"unexpected response verb {header.get('verb')!r}")
         return header.get("lines", "")
+
+    def swarm_stats(self, peer_id: str, timeout: float = 5.0) -> dict:
+        """One peer's swarm view (the ``swarm-stats`` verb): its own stats
+        digest under ``"self"`` plus every live gossip record it holds
+        under ``"records"`` — the input for ``--mode top``."""
+        sock = self._connect(peer_id)
+        try:
+            sock.settimeout(timeout)
+            _send_frame(sock, {"verb": "swarm-stats"})
+            header, _ = _recv_frame(sock)
+        except (ConnectionError, OSError) as exc:
+            self._drop(peer_id)
+            raise PeerUnavailable(f"peer {peer_id}: {exc}")
+        if header.get("verb") != "swarm-stats":
+            raise WireError(
+                f"unexpected response verb {header.get('verb')!r}")
+        return header
 
     # -- chaos layer (runtime.faults) -----------------------------------
 

@@ -33,6 +33,7 @@ from typing import Callable, Dict, Optional
 from ..telemetry import catalog as _tm
 from ..telemetry import events as _ev
 from ..telemetry import get_tracer
+from ..telemetry.profiling import get_profiler as _get_profiler
 from .executor import StageExecutor
 from .messages import (
     BackwardRequest,
@@ -199,6 +200,7 @@ class LocalTransport(Transport):
 
     def call(self, peer_id: str, request: StageRequest,
              timeout: Optional[float] = None) -> StageResponse:
+        t_in = time.monotonic()
         with self._lock:
             self.calls += 1
             executor = self._peers.get(peer_id)
@@ -265,7 +267,12 @@ class LocalTransport(Transport):
         self._m_step.labels(phase=phase).observe(dur)
         self._m_tokens.labels(phase=phase).inc(request.seq_len)
         self._m_requests.labels(outcome="ok").inc()
-        span.set(cache_len=getattr(resp, "cache_len", 0)).end()
+        _get_profiler().observe("server", time.monotonic() - t_in)
+        # queue_s is the pre-compute wait at this boundary (admission checks,
+        # injected stalls); the doctor's critical-path attribution reads it
+        # back out of the span to split the hop into queue vs compute.
+        span.set(cache_len=getattr(resp, "cache_len", 0),
+                 queue_s=max(0.0, t0 - t_in)).end()
         if resp.hidden is not None:
             self._m_recv.inc(int(resp.hidden.nbytes))
         if request.trace is not None and hasattr(resp, "span"):

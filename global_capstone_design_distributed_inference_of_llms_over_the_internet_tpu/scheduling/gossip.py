@@ -271,6 +271,17 @@ class GossipNode:
             return sum(1 for e in self._entries.values()
                        if not e.dead and e.expires_at > now)
 
+    def live_records(self) -> List[dict]:
+        """Verbatim wire-form record dicts of every live entry. Unlike
+        ``live_servers()`` (which projects through ServerRecord and drops
+        unknown keys), this keeps extras like the piggybacked ``stats``
+        digest — the swarm-top view reads those."""
+        now = time.monotonic()
+        with self._lock:
+            return [dict(e.rec) for e in self._entries.values()
+                    if not e.dead and e.rec is not None
+                    and e.expires_at > now]
+
     def select_peers(self, extra: Sequence[str] = ()) -> List[str]:
         """Up to `fanout` random peer addresses to exchange with this tick:
         the mirror's live records plus any `extra` addresses the caller

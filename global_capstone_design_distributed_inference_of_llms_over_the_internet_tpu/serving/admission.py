@@ -62,6 +62,12 @@ class TenantConfig:
     rate: float = 50.0         # admissions/s the bucket refills
     burst: float = 100.0       # bucket capacity (max admission burst)
     max_concurrency: int = 64  # queued + generating at once
+    # Declared latency objectives (None = no SLO for that dimension). The
+    # gateway's SloTracker turns violations into rolling burn rates
+    # (gateway_slo_* metrics, --mode top).
+    slo_ttft_s: Optional[float] = None    # submit-to-first-token objective
+    slo_token_s: Optional[float] = None   # per-decode-token objective
+    slo_target: float = 0.99              # fraction that must meet objective
 
     def __post_init__(self):
         if self.weight <= 0:
@@ -72,6 +78,13 @@ class TenantConfig:
         if self.max_concurrency <= 0:
             raise ValueError(f"tenant {self.name}: max_concurrency must "
                              "be > 0")
+        for field in ("slo_ttft_s", "slo_token_s"):
+            v = getattr(self, field)
+            if v is not None and v <= 0:
+                raise ValueError(f"tenant {self.name}: {field} must be > 0")
+        if not 0.0 < self.slo_target < 1.0:
+            raise ValueError(f"tenant {self.name}: slo_target must be in "
+                             "(0, 1)")
 
 
 class TokenBucket:
@@ -210,5 +223,10 @@ def parse_tenants_config(
             rate=float(spec.get("rate", 50.0)),
             burst=float(spec.get("burst", 100.0)),
             max_concurrency=int(spec.get("max_concurrency", 64)),
+            slo_ttft_s=(float(spec["slo_ttft_s"])
+                        if spec.get("slo_ttft_s") is not None else None),
+            slo_token_s=(float(spec["slo_token_s"])
+                         if spec.get("slo_token_s") is not None else None),
+            slo_target=float(spec.get("slo_target", 0.99)),
         )
     return tenants, max_queue_depth, max_active

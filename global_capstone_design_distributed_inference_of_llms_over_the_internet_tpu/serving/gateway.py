@@ -37,10 +37,99 @@ from ..telemetry import catalog as _tm
 from ..telemetry import events as _ev
 from ..telemetry import exposition as _texp
 from ..telemetry import get_registry as _get_metrics_registry
+from ..telemetry.profiling import get_profiler as _get_profiler
 from .admission import AdmissionController, Overloaded, TenantConfig
 from .fair_queue import DeficitRoundRobin, FairQueue
 
 logger = logging.getLogger(__name__)
+
+
+class SloTracker:
+    """Rolling-window SLO burn rates per tenant and objective.
+
+    Tenants declare latency objectives in their config (``slo_ttft_s``,
+    ``slo_token_s``, met by at least ``slo_target`` of observations). Each
+    observation lands in a rolling window as good or bad; the burn rate is
+
+        (bad fraction over the window) / (1 - slo_target)
+
+    — the Tail-at-Scale/SRE convention: 1.0 consumes the error budget at
+    exactly the sustainable rate, >1.0 is on course to violate the SLO, and
+    a 100%-bad window with a 99% target burns at 100x. Rates surface as the
+    ``gateway_slo_burn_rate`` gauge, the gateway ``info`` verb (--mode top),
+    and the doctor. Clock injectable so tests pin the window."""
+
+    def __init__(self, tenants: Dict[str, TenantConfig],
+                 window_s: float = 300.0, now=time.monotonic):
+        self.tenants = tenants
+        self.window_s = float(window_s)
+        self._now = now
+        self._lock = threading.Lock()
+        # {(tenant, objective): deque[(stamp, bad)]}
+        self._obs: Dict[tuple, deque] = {}
+
+    def _limit(self, tenant: str, objective: str) -> Optional[float]:
+        cfg = self.tenants.get(tenant)
+        if cfg is None:
+            return None
+        return cfg.slo_ttft_s if objective == "ttft" else cfg.slo_token_s
+
+    def observe(self, tenant: str, objective: str, seconds: float) -> None:
+        """Record one latency observation against the tenant's declared
+        objective (no-op for tenants without one)."""
+        limit = self._limit(tenant, objective)
+        if limit is None:
+            return
+        bad = seconds > limit
+        if bad:
+            name = ("gateway_slo_ttft_violations_total" if objective == "ttft"
+                    else "gateway_slo_token_violations_total")
+            _tm.get(name).labels(tenant=tenant).inc()
+        now = self._now()
+        with self._lock:
+            dq = self._obs.setdefault((tenant, objective), deque())
+            dq.append((now, bad))
+            self._prune_locked(dq, now)
+        _tm.get("gateway_slo_burn_rate").labels(
+            tenant=tenant, objective=objective).set(
+                self.burn_rate(tenant, objective))
+
+    def _prune_locked(self, dq: deque, now: float) -> None:
+        horizon = now - self.window_s
+        while dq and dq[0][0] < horizon:
+            dq.popleft()
+
+    def burn_rate(self, tenant: str, objective: str) -> float:
+        """Error-budget burn rate over the rolling window (0.0 with no
+        observations or no declared objective)."""
+        cfg = self.tenants.get(tenant)
+        if cfg is None or self._limit(tenant, objective) is None:
+            return 0.0
+        now = self._now()
+        with self._lock:
+            dq = self._obs.get((tenant, objective))
+            if not dq:
+                return 0.0
+            self._prune_locked(dq, now)
+            total = len(dq)
+            bad = sum(1 for _, b in dq if b)
+        if total == 0:
+            return 0.0
+        return (bad / total) / max(1e-9, 1.0 - cfg.slo_target)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """{tenant: {objective: burn_rate}} for every declared objective —
+        the shape the gateway ``info`` verb ships to ``--mode top``."""
+        out: Dict[str, Dict[str, float]] = {}
+        for tenant, cfg in self.tenants.items():
+            objs = {}
+            if cfg.slo_ttft_s is not None:
+                objs["ttft"] = round(self.burn_rate(tenant, "ttft"), 3)
+            if cfg.slo_token_s is not None:
+                objs["token"] = round(self.burn_rate(tenant, "token"), 3)
+            if objs:
+                out[tenant] = objs
+        return out
 
 
 class _GatewayRequest:
@@ -122,6 +211,7 @@ class GatewayServer(_FramedTcpServer):
         # Audit trail for fairness assertions: the tenant of each served
         # token, in service order (bounded; soaks read a prefix).
         self.step_log: deque = deque(maxlen=4096)
+        self.slo = SloTracker(self.tenants)
         self._paused = threading.Event()
         if not start_paused:
             self._paused.set()
@@ -174,6 +264,7 @@ class GatewayServer(_FramedTcpServer):
         queue_wait = time.monotonic() - req.admitted_at
         _tm.get("gateway_queue_wait_seconds").labels(
             tenant=tenant).observe(queue_wait)
+        _get_profiler().observe("gateway_queue", queue_wait)
         cfg = self.tenants[tenant]
         stepper = client.generate_stepwise(
             req.prompt_ids, req.max_new_tokens, sampling=req.sampling,
@@ -213,6 +304,7 @@ class GatewayServer(_FramedTcpServer):
 
     def _step_session(self, sess: _ActiveSession) -> None:
         tenant = sess.req.tenant
+        t_step = time.monotonic()
         try:
             step = next(sess.stepper)
         except StopIteration:
@@ -227,8 +319,16 @@ class GatewayServer(_FramedTcpServer):
         if step.new_tokens:
             if sess.first_token_at is None:
                 sess.first_token_at = time.monotonic()
-                _tm.get("gateway_ttft_seconds").labels(tenant=tenant).observe(
-                    sess.first_token_at - sess.req.admitted_at)
+                ttft = sess.first_token_at - sess.req.admitted_at
+                _tm.get("gateway_ttft_seconds").labels(
+                    tenant=tenant).observe(ttft)
+                self.slo.observe(tenant, "ttft", ttft)
+            else:
+                # Decode steps only: the first step's wall time IS the TTFT
+                # and is judged by that objective, not the per-token one.
+                self.slo.observe(
+                    tenant, "token",
+                    (time.monotonic() - t_step) / len(step.new_tokens))
             m_tokens = _tm.get("gateway_tokens_served_total").labels(
                 tenant=tenant)
             for tok in step.new_tokens:
@@ -314,6 +414,7 @@ class GatewayServer(_FramedTcpServer):
                 "queue_depth": self.queue.depth(),
                 "active_sessions": len(self._sessions),
                 "sessions_started": self._sessions_started,
+                "slo": self.slo.snapshot(),
             })
             return
         _send_frame(sock, {"verb": "error",
@@ -414,6 +515,17 @@ class GatewaySubmitClient:
     def __init__(self, address: str, connect_timeout: float = 5.0):
         self.address = address
         self.connect_timeout = connect_timeout
+
+    def info(self, timeout: float = 5.0) -> dict:
+        """The gateway's ``info`` verb: queue depth, active sessions, and
+        the per-tenant SLO burn-rate snapshot (``--mode top`` row)."""
+        host, port = self.address.rsplit(":", 1)
+        with socket.create_connection((host, int(port)),
+                                      timeout=self.connect_timeout) as sock:
+            sock.settimeout(timeout)
+            _send_frame(sock, {"verb": "info"})
+            resp, _ = _recv_frame(sock)
+            return resp
 
     def submit(self, tenant: str, prompt_ids: Sequence[int],
                max_new_tokens: int = 64, *, temperature: float = 0.0,

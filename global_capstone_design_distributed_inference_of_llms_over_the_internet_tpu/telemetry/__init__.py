@@ -53,6 +53,15 @@ from .metrics import (
     MetricsRegistry,
     get_registry,
 )
+from .profiling import (
+    DIGEST_FIELDS,
+    PHASES,
+    PhaseProfiler,
+    disable_phase_profiling,
+    enable_phase_profiling,
+    get_profiler,
+    stats_digest,
+)
 from .tracing import NOOP_SPAN, Span, Tracer, get_tracer, new_id, reconstruct
 
 
@@ -85,5 +94,7 @@ __all__ = [
     "StructuredFormatter", "setup_logging", "set_log_context",
     "clear_log_context", "log_context",
     "render", "summary",
+    "DIGEST_FIELDS", "PHASES", "PhaseProfiler", "get_profiler",
+    "enable_phase_profiling", "disable_phase_profiling", "stats_digest",
     "enable", "disable", "enabled",
 ]

@@ -217,6 +217,28 @@ SPEC: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Sequence[float]]]] = {
     "gateway_active_sessions": (
         GAUGE, "Sessions currently being decoded by the gateway's step "
                "scheduler.", (), None),
+    # -- gateway SLOs ---------------------------------------------------------
+    "gateway_slo_ttft_violations_total": (
+        COUNTER, "First tokens delivered later than the tenant's declared "
+                 "TTFT objective.", ("tenant",), None),
+    "gateway_slo_token_violations_total": (
+        COUNTER, "Decode steps slower than the tenant's declared per-token "
+                 "latency objective.", ("tenant",), None),
+    "gateway_slo_burn_rate": (
+        GAUGE, "Error-budget burn rate over the rolling SLO window, per "
+               "tenant and objective (ttft|token): 1.0 consumes the budget "
+               "exactly at the target rate, >1.0 is on course to violate "
+               "the SLO.", ("tenant", "objective"), None),
+    # -- phase profiler (--profile_phases) ------------------------------------
+    "server_phase_seconds": (
+        HISTOGRAM, "Serving hot-path phase wall time from the phase "
+                   "profiler, per phase (gateway_queue|burst_build|dispatch|"
+                   "device|readback|socket|server).",
+        ("phase",), FAST_BUCKETS),
+    "server_device_bubble_ratio": (
+        GAUGE, "Fraction of wall time the accelerator sat idle between "
+               "burst dispatches (0..1; phase profiler's live meter for "
+               "device-bound vs host-bound).", (), None),
 }
 
 

@@ -365,6 +365,171 @@ def diagnose_streams(streams: Sequence[dict]) -> str:
     return "\n".join(lines) + "\n"
 
 
+# -- critical-path analysis ---------------------------------------------------
+#
+# Spans ride dumps as `_spans` records (telemetry/events.py): the client's
+# root `pipeline_step` span per request step, one `hop:<key>` child per
+# stage call, and — embedded in each hop's attrs under "server" — the
+# serving peer's own span summary (StageResponse.span), which carries the
+# peer's compute window plus its pre-compute `queue_s`. That is enough to
+# split every request's wall time into the four places it can go.
+
+
+def _span_dur(sp: dict) -> float:
+    try:
+        return max(0.0, float(sp["end_s"]) - float(sp["start_s"]))
+    except (KeyError, TypeError, ValueError):
+        return 0.0
+
+
+def critical_path_reports(streams: Sequence[dict]) -> List[dict]:
+    """Per-request wall-time attribution from the span trees in `streams`.
+
+    One report per finished root `pipeline_step` span:
+
+      {"trace_id", "phase", "wall_s", "hops": n,
+       "parts": {"network", "queue", "compute", "replay", "client"},
+       "path": [(span name, seconds), ...]}   # the critical path
+
+    The parts are constructed to SUM to wall_s exactly (up to float
+    rounding): each hop's wall decomposes into server compute + server
+    queue + network (the remainder, with replay seconds carved out of it
+    when a KV replay fell inside the request), and whatever the hops do
+    not cover is client-side time (sampling, stop scans, journaling)."""
+    spans: List[dict] = []
+    for st in streams:
+        spans.extend(st.get("spans") or ())
+    replay_events = [ev for ev in merge_timeline(streams)
+                     if ev.get("event") == "replay_done"]
+
+    by_trace: Dict[str, List[dict]] = {}
+    for sp in spans:
+        tid = sp.get("trace_id")
+        if tid:
+            by_trace.setdefault(str(tid), []).append(sp)
+
+    reports: List[dict] = []
+    for tid, group in by_trace.items():
+        seen = set()
+        for root in sorted(group, key=lambda s: s.get("start_s", 0.0)):
+            if root.get("name") != "pipeline_step" \
+                    or root.get("end_s") is None \
+                    or root.get("span_id") in seen:
+                continue
+            seen.add(root.get("span_id"))
+            wall = _span_dur(root)
+            hops = sorted(
+                (s for s in group
+                 if s.get("parent") == root.get("span_id")
+                 and str(s.get("name", "")).startswith("hop:")
+                 and s.get("end_s") is not None),
+                key=lambda s: s.get("start_s", 0.0))
+            # Replay seconds inside this request's wall-clock window.
+            replay_budget = 0.0
+            for ev in replay_events:
+                in_trace = ev.get("trace") == tid
+                in_window = (root["start_s"] <= ev.get("wall", -1.0)
+                             <= root["end_s"])
+                if in_trace or in_window:
+                    try:
+                        replay_budget += float(
+                            _fields(ev).get("seconds", 0.0))
+                    except (TypeError, ValueError):
+                        pass
+            net = queue = compute = replay = 0.0
+            best_hop: Optional[dict] = None
+            best_srv: Optional[dict] = None
+            for hop in hops:
+                hop_wall = _span_dur(hop)
+                srv = (hop.get("attrs") or {}).get("server")
+                if not isinstance(srv, dict):
+                    srv = None
+                srv_dur = min(_span_dur(srv), hop_wall) if srv else 0.0
+                try:
+                    q_raw = float((srv.get("attrs") or {}).get("queue_s",
+                                                               0.0)) \
+                        if srv else 0.0
+                except (TypeError, ValueError):
+                    q_raw = 0.0
+                q = min(max(0.0, q_raw), hop_wall - srv_dur)
+                n = hop_wall - srv_dur - q
+                r = min(replay_budget, n)
+                replay_budget -= r
+                n -= r
+                compute += srv_dur
+                queue += q
+                net += n
+                replay += r
+                if best_hop is None or hop_wall > _span_dur(best_hop):
+                    best_hop, best_srv = hop, srv
+            covered = net + queue + compute + replay
+            parts = {
+                "network": net,
+                "queue": queue,
+                "compute": compute,
+                "replay": replay,
+                # Exact residual: the sum of the five parts IS wall_s.
+                "client": wall - covered,
+            }
+            path = [(str(root.get("name")), wall)]
+            if best_hop is not None:
+                path.append((str(best_hop.get("name")),
+                             _span_dur(best_hop)))
+                if best_srv is not None:
+                    path.append((str(best_srv.get("name", "server")),
+                                 _span_dur(best_srv)))
+            reports.append({
+                "trace_id": tid,
+                "phase": (root.get("attrs") or {}).get("phase"),
+                "wall_s": wall,
+                "hops": len(hops),
+                "parts": parts,
+                "path": path,
+            })
+    reports.sort(key=lambda r: -r["wall_s"])
+    return reports
+
+
+def render_critical_path(reports: Sequence[dict],
+                         top_n: int = 10) -> str:
+    """The human-readable section ``--mode doctor --critical_path``
+    appends: aggregate attribution first, then the slowest requests."""
+    lines: List[str] = []
+    lines.append(f"critical path ({len(reports)} request(s) with span "
+                 "trees):")
+    if not reports:
+        lines.append("  none — no finished pipeline_step spans in these "
+                     "dumps (run with --telemetry and --events-dump)")
+        return "\n".join(lines) + "\n"
+    total = {"network": 0.0, "queue": 0.0, "compute": 0.0, "replay": 0.0,
+             "client": 0.0}
+    wall_total = 0.0
+    for r in reports:
+        wall_total += r["wall_s"]
+        for k in total:
+            total[k] += r["parts"][k]
+    lines.append(f"  aggregate over {len(reports)} request(s), "
+                 f"{wall_total * 1e3:.1f} ms total wall:")
+    for k in ("compute", "network", "queue", "replay", "client"):
+        pct = 100.0 * total[k] / wall_total if wall_total > 0 else 0.0
+        lines.append(f"    {k:<8} {total[k] * 1e3:9.2f} ms  {pct:5.1f}%")
+    lines.append("")
+    lines.append(f"  slowest request(s) (top {min(top_n, len(reports))}):")
+    for r in reports[:top_n]:
+        p = r["parts"]
+        chain = " -> ".join(f"{name} {dur * 1e3:.2f}ms"
+                            for name, dur in r["path"])
+        lines.append(
+            f"    trace={r['trace_id']} phase={r['phase'] or '?'} "
+            f"hops={r['hops']} wall={r['wall_s'] * 1e3:.2f}ms "
+            f"[compute {p['compute'] * 1e3:.2f} / net "
+            f"{p['network'] * 1e3:.2f} / queue {p['queue'] * 1e3:.2f} / "
+            f"replay {p['replay'] * 1e3:.2f} / client "
+            f"{p['client'] * 1e3:.2f}]")
+        lines.append(f"      critical path: {chain}")
+    return "\n".join(lines) + "\n"
+
+
 def scrape_events(transport, peer_ids: Sequence[str]) -> List[dict]:
     """Live-scrape variant: pull each peer's recorder over the
     ``dump-events`` wire verb (TcpTransport.events_text) and parse it like
@@ -383,6 +548,7 @@ def scrape_events(transport, peer_ids: Sequence[str]) -> List[dict]:
         meta: dict = {"peer": pid}
         metrics: Optional[dict] = None
         events: List[dict] = []
+        spans: List[dict] = []
         for line in text.splitlines():
             line = line.strip()
             if not line:
@@ -395,8 +561,11 @@ def scrape_events(transport, peer_ids: Sequence[str]) -> List[dict]:
                 meta.update(d)
             elif d.get("record") == "_metrics":
                 metrics = d
+            elif d.get("record") == "_spans":
+                spans.extend(d.get("spans") or [])
             elif "event" in d:
                 events.append(d)
         streams.append({"meta": meta, "metrics": metrics,
-                        "events": events, "path": f"live:{pid}"})
+                        "events": events, "spans": spans,
+                        "path": f"live:{pid}"})
     return streams

@@ -355,9 +355,11 @@ class EventRecorder:
     # -- dumping ------------------------------------------------------------
 
     def render_jsonl(self, registry=None) -> str:
-        """The dump format: line 1 a `_meta` record, line 2 an optional
+        """The dump format: line 1 a `_meta` record, then an optional
         `_metrics` record embedding the registry's Prometheus exposition,
-        then one event per line, oldest first."""
+        an optional `_spans` record embedding the tracer's recent span
+        buffer (the doctor's critical-path input), then one event per
+        line, oldest first."""
         lines = [json.dumps({
             "record": "_meta", "pid": os.getpid(),
             "argv": list(sys.argv), "wall": time.time(),
@@ -373,6 +375,14 @@ class EventRecorder:
                 "record": "_metrics", "summary": summary(registry),
                 "exposition": render(registry),
             }, sort_keys=True))
+        from .tracing import get_tracer
+        tracer = get_tracer()
+        if tracer.enabled:
+            spans = [s.to_wire() for s in tracer.spans()]
+            if spans:
+                lines.append(json.dumps(
+                    {"record": "_spans", "spans": spans},
+                    sort_keys=True, default=str))
         for ev in self.events():
             lines.append(json.dumps(ev.to_dict(), sort_keys=True,
                                     default=str))
@@ -491,10 +501,11 @@ def install_crash_hooks(path: str,
 
 def load_dump(path: str) -> dict:
     """Parse one JSONL dump into {"meta": dict, "metrics": dict|None,
-    "events": [dict]}. Tolerates truncated trailing lines (a crash can cut
-    the final write short)."""
+    "spans": [dict], "events": [dict]}. Tolerates truncated trailing lines
+    (a crash can cut the final write short)."""
     meta: dict = {}
     metrics: Optional[dict] = None
+    spans: List[dict] = []
     events: List[dict] = []
     with open(path, "r", encoding="utf-8") as f:
         for line in f:
@@ -509,7 +520,9 @@ def load_dump(path: str) -> dict:
                 meta = d
             elif d.get("record") == "_metrics":
                 metrics = d
+            elif d.get("record") == "_spans":
+                spans.extend(d.get("spans") or [])
             elif "event" in d:
                 events.append(d)
-    return {"meta": meta, "metrics": metrics, "events": events,
-            "path": path}
+    return {"meta": meta, "metrics": metrics, "spans": spans,
+            "events": events, "path": path}

@@ -32,6 +32,10 @@ from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.
     EVENTS,
     all_event_names,
 )
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.telemetry.profiling import (  # noqa: E402
+    DIGEST_FIELDS,
+    PHASES,
+)
 
 DOC = REPO / "docs" / "OBSERVABILITY.md"
 
@@ -39,7 +43,7 @@ DOC = REPO / "docs" / "OBSERVABILITY.md"
 # keeps prose like `server_forward` (a span name) out of scope.
 _DOC_METRIC_RE = re.compile(
     r"`((?:server|client|transport|scheduler|gateway)_[a-z0-9_]+"
-    r"(?:_total|_seconds|_bytes|_ratio|_sessions|_hops|_depth))`"
+    r"(?:_total|_seconds|_bytes|_ratio|_sessions|_hops|_depth|_rate))`"
 )
 
 # Event names in the doc's event table: backticked first-column cells.
@@ -62,8 +66,14 @@ def main() -> int:
                        if f"`{n}`" not in text]
     ev_unknown = sorted(
         {m for m in _DOC_EVENT_RE.findall(text)
-         if m not in EVENTS and m not in SPEC}
+         if m not in EVENTS and m not in SPEC
+         and m not in PHASES and m not in DIGEST_FIELDS}
     )
+    # The profiler's phase names and the gossiped stats-digest fields are
+    # operator surface too (--profile_phases histograms, --mode top
+    # columns): each must appear backticked in the doc.
+    prof_undocumented = [n for n in (*PHASES, *DIGEST_FIELDS)
+                         if f"`{n}`" not in text]
 
     if undocumented:
         print("metrics in telemetry/catalog.py missing from "
@@ -85,10 +95,17 @@ def main() -> int:
               "from telemetry/events.py:")
         for n in ev_unknown:
             print(f"  {n}")
-    if undocumented or unknown or ev_undocumented or ev_unknown:
+    if prof_undocumented:
+        print("profiler phases / stats-digest fields (telemetry/"
+              "profiling.py) missing from docs/OBSERVABILITY.md:")
+        for n in prof_undocumented:
+            print(f"  {n}")
+    if (undocumented or unknown or ev_undocumented or ev_unknown
+            or prof_undocumented):
         return 1
-    print(f"ok: {len(all_names())} metrics and {len(all_event_names())} "
-          "events documented")
+    print(f"ok: {len(all_names())} metrics, {len(all_event_names())} "
+          f"events, {len(PHASES)} phases, and {len(DIGEST_FIELDS)} digest "
+          "fields documented")
     return 0
 
 

@@ -1,0 +1,320 @@
+"""Hot-path phase profiler, critical-path doctor, swarm top, SLO burn rates.
+
+Five concerns, matching ISSUE 9's test checklist:
+
+  * phase attribution: bracketed phase totals sum to the simulated wall
+    time, and the default-off profiler is a shared-noop zero-cost path;
+  * device bubble fraction: a synthetic host stall between dispatches
+    yields exactly the expected idle fraction, overlapped (double-
+    buffered) dispatches yield zero;
+  * the doctor's critical-path analysis over a REAL 2-stage in-process
+    trace — the network/queue/compute/replay/client parts must SUM to
+    each request's wall time (the acceptance-pinned property);
+  * ``--mode top --once`` renders the swarm table from gossip-carried
+    stats digests with every seed registry dead;
+  * per-tenant SLO burn-rate math under an injected clock.
+"""
+
+import random
+
+import pytest
+
+from test_runtime_pipeline import build_cluster, tiny_cfg
+
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu import (
+    main as main_mod,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu import (
+    telemetry,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.ops.sampling import (
+    SamplingParams,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.net import (
+    RegistryServer,
+    RemoteRegistry,
+    TcpStageServer,
+    gossip_exchange,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.scheduling.gossip import (
+    GossipNode,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.scheduling.registry import (
+    ServerRecord,
+    rec_to_dict,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.serving.admission import (
+    TenantConfig,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.serving.gateway import (
+    SloTracker,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.telemetry import (
+    MetricsRegistry,
+    catalog,
+    events,
+    get_tracer,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.telemetry import (
+    doctor as doc,
+)
+from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.telemetry.profiling import (
+    DIGEST_FIELDS,
+    PhaseProfiler,
+    disable_phase_profiling,
+    enable_phase_profiling,
+    get_profiler,
+    stats_digest,
+)
+
+
+# -- phase profiler -----------------------------------------------------------
+
+def test_profiler_default_off_is_shared_noop():
+    p = PhaseProfiler(enabled=False)
+    b1, b2 = p.phase("dispatch"), p.phase("device")
+    assert b1 is b2                        # ONE shared bracket, no alloc
+    with b1:
+        pass
+    p.observe("dispatch", 1.0)
+    p.device_interval(0.0, 1.0)
+    assert p.snapshot() == {}
+    assert p.bubble_fraction() == 0.0
+    # The process-global profiler starts dark.
+    assert get_profiler().enabled is False
+
+
+def test_phase_attribution_sums_to_wall():
+    reg = MetricsRegistry(enabled=True)
+    p = PhaseProfiler(enabled=True, registry=reg)
+    # One simulated request: the bracketed phases partition its wall time.
+    wall = 0.0
+    for name, dur in (("gateway_queue", 0.004), ("burst_build", 0.002),
+                      ("dispatch", 0.001), ("device", 0.010),
+                      ("readback", 0.003)):
+        p.observe(name, dur)
+        wall += dur
+    snap = p.snapshot()
+    assert sum(st["total_s"] for st in snap.values()) == pytest.approx(wall)
+    assert snap["device"]["count"] == 1
+    assert snap["device"]["mean_s"] == pytest.approx(0.010)
+    # Mirrored into the catalog histogram (per-phase child).
+    fam = reg.get("server_phase_seconds")
+    by_phase = {dict(h.labels)["phase"]: h for h in fam.children()}
+    assert by_phase["device"].count == 1
+    assert by_phase["device"].sum == pytest.approx(0.010)
+
+
+def test_bubble_fraction_synthetic_stall():
+    p = PhaseProfiler(enabled=True, registry=MetricsRegistry(enabled=False))
+    # Burst 1 runs [0, 1]; the host then stalls 0.5s before dispatching
+    # burst 2, which runs [1.5, 2.5]: wall 2.5, busy 2.0 → bubble 0.2.
+    p.device_interval(0.0, 1.0)
+    p.device_interval(1.5, 2.5)
+    assert p.bubble_fraction() == pytest.approx(0.2)
+
+    # Overlapped (double-buffered) dispatch: burst 2 is enqueued at 0.8,
+    # BEFORE burst 1 drains at 1.0 — no idle device time, zero bubble.
+    p2 = PhaseProfiler(enabled=True, registry=MetricsRegistry(enabled=False))
+    p2.device_interval(0.0, 1.0)
+    p2.device_interval(0.8, 1.9)
+    assert p2.bubble_fraction() == pytest.approx(0.0)
+
+
+def test_profiled_pipeline_populates_socket_and_server_phases():
+    """With the global profiler on, a REAL 2-stage generation populates the
+    client-side socket phase and the serving-boundary server phase."""
+    enable_phase_profiling()
+    prof = get_profiler()
+    prof.reset()
+    try:
+        cfg = tiny_cfg()
+        client, _, _, _, _ = build_cluster(cfg, splits="3,6")
+        client.generate([5, 9, 23, 7, 81], max_new_tokens=3,
+                        sampling=SamplingParams(temperature=0.0))
+        snap = prof.snapshot()
+        assert snap["socket"]["count"] >= 1
+        assert snap["server"]["count"] >= 2    # 2 remote stages per step
+        assert snap["server"]["total_s"] > 0.0
+    finally:
+        disable_phase_profiling()
+        prof.reset()
+
+
+# -- stats digest -------------------------------------------------------------
+
+def test_stats_digest_has_every_field():
+    reg = MetricsRegistry(enabled=True)
+    catalog.register_all(reg)
+    d = stats_digest(registry=reg, profiler=PhaseProfiler(enabled=True))
+    assert set(d) == set(DIGEST_FIELDS)
+    for v in d.values():
+        assert isinstance(v, (int, float))
+
+
+# -- doctor critical path -----------------------------------------------------
+
+def _trace_a_generation(tmp_path):
+    """Run a real 2-remote-hop generation under tracing and return the
+    dump-file stream the doctor would load."""
+    telemetry.enable()
+    tracer = get_tracer()
+    tracer.clear()
+    events.get_recorder().enable()
+    events.get_recorder().clear()
+    try:
+        cfg = tiny_cfg()
+        client, _, _, _, _ = build_cluster(cfg, splits="3,6")
+        client.generate([5, 9, 23, 7, 81], max_new_tokens=3,
+                        sampling=SamplingParams(temperature=0.0))
+        path = str(tmp_path / "trace.jsonl")
+        events.get_recorder().dump(path, registry=telemetry.get_registry())
+        return events.load_dump(path), path
+    finally:
+        telemetry.disable()
+        tracer.clear()
+
+
+def test_critical_path_parts_sum_to_wall(tmp_path):
+    stream, _ = _trace_a_generation(tmp_path)
+    assert stream["spans"], "dump carried no _spans record"
+    reports = doc.critical_path_reports([stream])
+    assert reports, "no pipeline_step roots reconstructed"
+    decode = [r for r in reports if r["phase"] == "decode"]
+    assert decode, "no decode-step traces"
+    for r in reports:
+        parts = r["parts"]
+        assert set(parts) == {"network", "queue", "compute", "replay",
+                              "client"}
+        # THE acceptance property: attribution sums to the request wall.
+        assert sum(parts.values()) == pytest.approx(r["wall_s"],
+                                                    rel=1e-9, abs=1e-12)
+        for k in ("network", "queue", "compute", "replay"):
+            assert parts[k] >= 0.0
+        assert parts["client"] >= -1e-9    # residual; hops nest in root
+    for r in decode:
+        assert r["hops"] == 2              # stage1 + stage2
+        assert r["parts"]["compute"] > 0.0
+        # Critical path descends root → slowest hop → its server span.
+        names = [n for n, _ in r["path"]]
+        assert names[0] == "pipeline_step"
+        assert names[1].startswith("hop:")
+        assert names[2] == "server_forward"
+
+
+def test_doctor_cli_renders_critical_path(tmp_path, capsys):
+    _, path = _trace_a_generation(tmp_path)
+    rc = main_mod.main(["--mode", "doctor", "--dumps", path,
+                        "--critical_path"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "critical path" in out
+    assert "compute" in out and "network" in out
+    # Without the flag the section stays out of the report.
+    rc = main_mod.main(["--mode", "doctor", "--dumps", path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "critical path" not in out
+
+
+# -- swarm top ----------------------------------------------------------------
+
+def _mirror_server(peer_id):
+    node = GossipNode(peer_id, ttl=30.0, rng=random.Random(0))
+    srv = TcpStageServer(None, wire_dtype="f32", peer_id=peer_id,
+                         gossip=node)
+    srv.start()
+    node.self_address = srv.address
+    return node, srv
+
+
+def _stats(tok_s):
+    return {"tok_s": tok_s, "tokens_total": 100.0, "queue_depth": 1.0,
+            "breaker_open": 0.0, "cache_hit_ratio": 0.5,
+            "bubble_frac": 0.25, "uptime_s": 3.0}
+
+
+def test_mode_top_once_survives_total_registry_loss(tmp_path, capsys):
+    """--mode top --once keeps rendering the whole-swarm table after BOTH
+    seed registries die: records come through the peers cache + a mirror,
+    stats ride the gossip records verbatim."""
+    cache = str(tmp_path / "peers.json")
+    node1, srv1 = _mirror_server("top1")
+    node2, srv2 = _mirror_server("top2")
+    seeds = [RegistryServer(), RegistryServer()]
+    for s in seeds:
+        s.start()
+    seed_addrs = ",".join(s.address for s in seeds)
+    try:
+        rec1 = ServerRecord(peer_id="top1", start_block=0, end_block=4,
+                            stage_index=1, address=srv1.address)
+        rec2 = ServerRecord(peer_id="top2", start_block=4, end_block=8,
+                            stage_index=2, address=srv2.address)
+        rr = RemoteRegistry(seed_addrs, peers_cache=cache)
+        rr.register(rec1)
+        rr.register(rec2)
+        # One read while the seeds live persists the peers-cache snapshot
+        # (the bootstrap file a fresh top process survives seed loss with).
+        assert {r.peer_id for r in rr.live_servers()} == {"top1", "top2"}
+        node1.publish(dict(rec_to_dict(rec1), stats=_stats(12.5)))
+        node2.publish(dict(rec_to_dict(rec2), stats=_stats(7.25)))
+        # One anti-entropy exchange each way: both mirrors hold the full
+        # swarm (records + digests) before the control plane dies.
+        gossip_exchange(node1, srv2.address)
+        gossip_exchange(node2, srv1.address)
+        for s in seeds:
+            s.stop()                       # total seed-registry loss
+
+        rc = main_mod.main(["--mode", "top", "--once",
+                            "--registry_addr", seed_addrs,
+                            "--peers_cache", cache,
+                            "--gateway_addr", ""])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "top1" in out and "top2" in out
+        assert "gossip via" in out         # stats came from a mirror
+        # top2's digest arrived via gossip replication (the answering
+        # peer top1 shows its own LIVE digest instead — fresher).
+        assert "7.2" in out and "50.0" in out and "25.0" in out
+        assert "[0,4)" in out and "[4,8)" in out
+    finally:
+        srv1.stop()
+        srv2.stop()
+        for s in seeds:
+            s.stop()
+
+
+# -- SLO burn rates -----------------------------------------------------------
+
+def test_slo_burn_rate_math_with_injected_clock():
+    t = [0.0]
+    cfg = TenantConfig(name="gold", slo_ttft_s=0.1, slo_token_s=0.01,
+                       slo_target=0.9)
+    trk = SloTracker({"gold": cfg}, window_s=60.0, now=lambda: t[0])
+    # 8 good + 2 bad TTFTs at a 90% target: bad fraction 0.2 over an error
+    # budget of 0.1 → burning at 2x the sustainable rate.
+    for _ in range(8):
+        trk.observe("gold", "ttft", 0.05)
+    for _ in range(2):
+        trk.observe("gold", "ttft", 0.25)
+    assert trk.burn_rate("gold", "ttft") == pytest.approx(2.0)
+    # All per-token observations within objective: zero burn.
+    for _ in range(5):
+        trk.observe("gold", "token", 0.005)
+    snap = trk.snapshot()
+    assert snap["gold"]["ttft"] == pytest.approx(2.0)
+    assert snap["gold"]["token"] == 0.0
+    # The window forgets: 2 minutes later the bad epoch has aged out and
+    # one good observation leaves burn at zero.
+    t[0] = 120.0
+    trk.observe("gold", "ttft", 0.05)
+    assert trk.burn_rate("gold", "ttft") == 0.0
+
+
+def test_slo_tracker_ignores_undeclared_objectives():
+    cfg = TenantConfig(name="free")        # no objectives declared
+    trk = SloTracker({"free": cfg}, window_s=60.0)
+    trk.observe("free", "ttft", 99.0)
+    trk.observe("unknown-tenant", "ttft", 99.0)
+    assert trk.burn_rate("free", "ttft") == 0.0
+    assert trk.snapshot() == {}
