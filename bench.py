@@ -253,6 +253,87 @@ def bench_config(name, cfg, params, *, batch, max_len, s1, s2, prefill=64,
     }
 
 
+def bench_moe(*, num_experts=8, top_k=2, batch=2, max_len=128, s1=8, s2=48,
+              prefill=8, reps=2, sustained_gbps=None):
+    """Dense vs sparse MoE dispatch: the SAME mixtral-tiny params decoded
+    through the dense all-expert einsums (MOE_SPARSE=0) and the sparse
+    sort-and-dispatch path (models/moe.py, the default), both via the
+    standard slope-timed fused decode.
+
+    The headline is STRUCTURAL, not wall-clock: on a tiny CPU model the
+    tok/s pair is dispatch noise, but the executed MLP FLOPs drop from
+    ``E * N`` to ``E * C`` token-slots per layer, and the row asserts the
+    ratio lands at ``top_k / num_experts * capacity_factor`` (to per-expert
+    ceil slack) — the ∝ top_k/num_experts claim of ROADMAP item 4, pinned
+    at a token count large enough that rounding can't flatter it."""
+    import os
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models import (
+        mixtral_config,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.moe import (
+        dense_mlp_flops,
+        moe_capacity,
+        moe_capacity_factor,
+        sparse_mlp_flops,
+    )
+
+    cfg = mixtral_config(
+        num_experts=num_experts, num_experts_per_tok=top_k,
+        vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=96, max_position_embeddings=256)
+    params = init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.bfloat16)
+
+    # Env set/restore, same idiom as the NF4_KERNEL smoke row. Each
+    # bench_config call builds a fresh jit, so the flag is re-read at trace
+    # time — no stale-cache hazard.
+    prev = os.environ.get("MOE_SPARSE")
+    try:
+        os.environ["MOE_SPARSE"] = "0"
+        dense = bench_config("moe_dense", cfg, params, batch=batch,
+                             max_len=max_len, s1=s1, s2=s2, prefill=prefill,
+                             reps=reps, sustained_gbps=sustained_gbps)
+        os.environ["MOE_SPARSE"] = "1"
+        sparse = bench_config("moe_sparse", cfg, params, batch=batch,
+                              max_len=max_len, s1=s1, s2=s2, prefill=prefill,
+                              reps=reps, sustained_gbps=sustained_gbps)
+    finally:
+        if prev is None:
+            os.environ.pop("MOE_SPARSE", None)
+        else:
+            os.environ["MOE_SPARSE"] = prev
+
+    cf = moe_capacity_factor()
+    # Per-decode-step executed MLP FLOPs (N = batch tokens, all layers).
+    step_dense = cfg.num_layers * dense_mlp_flops(batch, cfg)
+    step_sparse = cfg.num_layers * sparse_mlp_flops(batch, cfg)
+    # Proportionality pinned at a prefill-sized dispatch: N large enough
+    # that the per-expert capacity ceil (±1 slot) is sub-percent slack.
+    n_ref = 512
+    ratio = sparse_mlp_flops(n_ref, cfg) / dense_mlp_flops(n_ref, cfg)
+    expect = min(1.0, top_k / num_experts * cf) if cf > 0 else 1.0
+    flops_ratio_ok = bool(abs(ratio - expect) <= 1.0 / n_ref)
+
+    dense_tps = dense.get("tokens_per_s") or 0.0
+    return {
+        "tokens_per_s": sparse["tokens_per_s"],
+        "tokens_per_s_dense": dense_tps,
+        "sparse_vs_dense": (round(sparse["tokens_per_s"] / dense_tps, 3)
+                            if dense_tps else None),
+        "step_ms": sparse["step_ms"],
+        "step_ms_dense": dense["step_ms"],
+        "num_experts": num_experts, "top_k": top_k,
+        "capacity_factor": cf,
+        "mlp_flops_step_dense": step_dense,
+        "mlp_flops_step_sparse": step_sparse,
+        "capacity_n512": moe_capacity(n_ref, num_experts, top_k),
+        "mlp_flops_ratio_n512": round(ratio, 4),
+        "flops_ratio_expected": round(expect, 4),
+        "flops_ratio_ok": flops_ratio_ok,
+        "batch": batch, "max_len": max_len,
+    }
+
+
 def bench_prefill(cfg, params, *, batch, seq, n1=8, n2=56, reps=4):
     """Prefill (TTFT) throughput + MFU, SLOPE-timed.
 
@@ -1679,6 +1760,13 @@ def main():
                 os.environ.pop("NF4_KERNEL", None)
             else:
                 os.environ["NF4_KERNEL"] = _prev_nk
+        # Sparse-vs-dense MoE dispatch pair (models/moe.py): CPU-safe
+        # structural row — the flops_ratio_ok assertion is the point here,
+        # the tok/s pair is dispatch noise at this size.
+        try:
+            rmoe = bench_moe(s1=4, s2=16, reps=1)
+        except Exception as exc:   # the MoE pair must not kill the smoke
+            rmoe = {"error": str(exc)[:200]}
         rp = bench_prefill(cfg, params, batch=2, seq=32, n1=2, n2=8, reps=1)
         rpx = bench_prefix_cache(cfg, params, seq=96, suffix=16, reps=2)
         rpd = bench_prefix_digest(cfg, seq=128, grain=64, reps=3)
@@ -1692,6 +1780,7 @@ def main():
             rgw = {"error": str(exc)[:200]}
         cfgs = {"smoke": r, "smoke_serving": rs, "smoke_serving_burst": rsb,
                 "smoke_int8_fold": rq8, "smoke_nf4_kernel": rq4,
+                "smoke_moe": rmoe,
                 "smoke_prefill": rp,
                 "smoke_prefix_cache": rpx, "smoke_prefix_digest": rpd,
                 "smoke_telemetry_overhead": rt,
@@ -1802,6 +1891,14 @@ def main():
                               dtype=jnp.bfloat16))
     except Exception as exc:   # the gateway row must not kill the bench
         results["gpt2_gateway_8req"] = {"error": str(exc)[:200]}
+    # Sparse MoE dispatch vs dense all-expert einsums (models/moe.py,
+    # ROADMAP item 4): same mixtral-tiny params through both paths, with
+    # the structural executed-FLOPs ratio asserted ∝ top_k/num_experts.
+    try:
+        results["moe_sparse_vs_dense"] = bench_moe(
+            s1=S1, s2=S2, sustained_gbps=sustained)
+    except Exception as exc:   # the MoE pair must not kill the bench
+        results["moe_sparse_vs_dense"] = {"error": str(exc)[:200]}
 
     fcfg = flagship_cfg()
     fparams = init_params(jax.random.PRNGKey(0), fcfg, dtype=jnp.bfloat16)

@@ -2721,7 +2721,8 @@ def _render_top(rows: list, source: str, gateway: Optional[dict]) -> str:
     gateway answered) per-tenant SLO burn rates."""
     lines = [f"swarm top — {len(rows)} server(s) (source: {source})"]
     hdr = (f"{'PEER':<14} {'SPAN':<10} {'TOK/S':>8} {'QUEUE':>6} "
-           f"{'BRK':>4} {'CACHE%':>7} {'BUBBLE%':>8} {'UP(S)':>8}")
+           f"{'BRK':>4} {'CACHE%':>7} {'BUBBLE%':>8} {'DROP%':>6} "
+           f"{'HOT%':>5} {'UP(S)':>8}")
     lines.append(hdr)
     lines.append("-" * len(hdr))
 
@@ -2745,6 +2746,8 @@ def _render_top(rows: list, source: str, gateway: Optional[dict]) -> str:
             f"{_f(stats, 'breaker_open', fmt='{:.0f}'):>4} "
             f"{_f(stats, 'cache_hit_ratio', 100.0):>7} "
             f"{_f(stats, 'bubble_frac', 100.0):>8} "
+            f"{_f(stats, 'moe_drop_frac', 100.0):>6} "
+            f"{_f(stats, 'moe_hot_share', 100.0):>5} "
             f"{_f(stats, 'uptime_s', fmt='{:.0f}'):>8}")
     if gateway is not None:
         lines.append("")

@@ -16,6 +16,8 @@ generalization to N stages is spans from consecutive boundary pairs.
 from __future__ import annotations
 
 import dataclasses
+import math
+import re
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -115,6 +117,43 @@ class StagePlan:
 def parse_splits(splits: str) -> List[int]:
     """"10,20,30" -> [10, 20, 30] (the reference flag format)."""
     return [int(x) for x in splits.split(",") if x.strip()]
+
+
+def path_name(path) -> str:
+    """tree_map_with_path key path -> "a/b/c" rule-matching name."""
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "idx", p)
+        parts.append(str(key))
+    return "/".join(parts)
+
+
+def match_partition_rules(rules, params) -> Params:
+    """(regex, PartitionSpec) rules -> a PartitionSpec pytree for `params`.
+
+    The explicit-rules idiom of the big SPMD trainers: each leaf's
+    "a/b/c" key path is matched against the rules IN ORDER and the first
+    ``re.search`` hit wins, so specific rules go first and a catch-all
+    ``(".*", P())`` closes the list (a leaf matching no rule raises —
+    silent replication of a weight that should shard corrupts psum'd
+    outputs). Scalar/singleton leaves are never partitioned. This is the
+    single mechanism behind `parallel.tensor_parallel`'s TP and MoE
+    expert-parallel layouts."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec_for(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(shape) == 0 or math.prod(shape) == 1:
+            return P()
+        name = path_name(path)
+        for rule, spec in rules:
+            if re.search(rule, name):
+                return spec
+        raise ValueError(f"no partition rule matches param {name!r}")
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
 def slice_stage_params(cfg: ModelConfig, params: Params, spec: StageSpec) -> Params:

@@ -263,7 +263,7 @@ def int8_fold_enabled() -> bool:
     return os.environ.get("INT8_FOLD", "1") == "1"
 
 
-def dequant_tree(tree: Params) -> Params:
+def dequant_tree(tree: Params, keep_experts: bool = False) -> Params:
     """Materialize full-precision weights for any quantized leaves (int8 or
     NF4). Identity (and free) for unquantized trees; under jit+scan this
     runs per layer, so only one layer's weights exist dequantized at a
@@ -273,17 +273,33 @@ def dequant_tree(tree: Params) -> Params:
     the matmul sites (`models.transformer._dot`) feed them to the fused
     kernel. With `int8_fold_enabled()` (default), per-layer (2-D) int8
     leaves stay packed the same way and run the scale-folded epilogue
-    (ops.int8_kernel). Stacked/expert (3-D) leaves of either format
-    still materialize (the MoE einsums have no kernel path)."""
+    (ops.int8_kernel).
+
+    `keep_experts=True` (the PER-LAYER MoE call sites: layer_forward and
+    the engine scan bodies, where any 3-D quantized leaf IS an [E, in,
+    out] expert stack) keeps those stacks packed too whenever the sparse
+    dispatch is on (`models.moe.moe_sparse_enabled`): the grouped matmuls
+    consume them per expert (int8 scale-folded einsum / NF4 one-expert-at-
+    a-time lax.map — models.moe._expert_dot), so a stage's resident expert
+    bytes stay at the quantized size. Default False because callers also
+    dequant whole STACKED trees, where a 3-D leaf is an [L, in, out] dense
+    weight, not an expert stack."""
     keep_nf4 = nf4_kernel_enabled()
     keep_int8 = int8_fold_enabled()
+    if keep_experts:
+        from .moe import moe_sparse_enabled
+
+        keep_experts = moe_sparse_enabled()
 
     def f(x):
         if not isinstance(x, _QUANT_TYPES):
             return x
-        if keep_nf4 and isinstance(x, NF4Tensor) and x.packed.ndim == 2:
+        nd = x.q.ndim if isinstance(x, QuantizedTensor) else x.packed.ndim
+        if keep_nf4 and isinstance(x, NF4Tensor) and nd == 2:
             return x
-        if keep_int8 and isinstance(x, QuantizedTensor) and x.q.ndim == 2:
+        if keep_int8 and isinstance(x, QuantizedTensor) and nd == 2:
+            return x
+        if keep_experts and nd == 3:
             return x
         return x.dequant()
 

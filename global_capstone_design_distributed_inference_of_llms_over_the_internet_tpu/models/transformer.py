@@ -343,11 +343,25 @@ def _mlp(cfg: ModelConfig, p: Params, x: jnp.ndarray, tp_axis: Optional[str]) ->
 def _moe_mlp(cfg: ModelConfig, p: Params, x: jnp.ndarray, tp_axis: Optional[str]) -> jnp.ndarray:
     """Mixtral-style top-k routed SwiGLU experts.
 
-    Dense formulation: every expert runs on every token and the router weights
-    zero out the non-selected ones. All-expert einsums keep the MXU busy with
-    static shapes; token-dropping dispatch is a later optimization (the
-    reference has no runnable MoE at all — only config guards,
-    ``src/llama_partition.py:82``).
+    Default: the sparse sort-by-expert grouped-matmul dispatch
+    (models.moe.sparse_moe_mlp) — executed MLP FLOPs proportional to
+    top_k/num_experts. MOE_SPARSE=0 falls back to the dense all-expert
+    formulation below, bit-for-bit the pre-dispatch behavior (tiny-model
+    fallback and kill switch). Both read the switch at trace time, so a
+    jitted engine picks its path when it first compiles."""
+    from .moe import moe_sparse_enabled, sparse_moe_mlp
+
+    if moe_sparse_enabled():
+        return sparse_moe_mlp(cfg, p, x, tp_axis)
+    return _moe_mlp_dense(cfg, p, x, tp_axis)
+
+
+def _moe_mlp_dense(cfg: ModelConfig, p: Params, x: jnp.ndarray, tp_axis: Optional[str]) -> jnp.ndarray:
+    """Dense MoE formulation: every expert runs on every token and the router
+    weights zero out the non-selected ones. All-expert einsums keep the MXU
+    busy with static shapes — MLP FLOPs scale with num_experts, so this is
+    the tiny-model fallback behind MOE_SPARSE=0 (the reference has no
+    runnable MoE at all — only config guards, ``src/llama_partition.py:82``).
     """
     router_logits = x.astype(jnp.float32) @ p["router"].astype(jnp.float32)  # [B,T,E]
     topv, topi = jax.lax.top_k(router_logits, cfg.num_experts_per_tok)
@@ -471,7 +485,9 @@ def layer_forward(
     # int8-serving hook: materialize full-precision weights for any
     # QuantizedTensor leaves. Inside lax.scan this runs per layer, so only
     # one layer's dequantized weights exist at a time (models/quant.py).
-    p = dequant_tree(p)
+    # keep_experts: on the sparse MoE path, 3-D expert stacks stay packed —
+    # the grouped matmuls dequantize per expert (models/moe._expert_dot).
+    p = dequant_tree(p, keep_experts=cfg.is_moe)
     # Per-layer window (gemma2 alternating local/global): a traced int32
     # "window" leaf on the layer tree — every engine's layer scan slices it
     # alongside the weights; <= 0 means global attention in this layer.

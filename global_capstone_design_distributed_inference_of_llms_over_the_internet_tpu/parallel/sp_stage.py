@@ -245,7 +245,7 @@ class SpStageRunner:
             def layer(h, lp):
                 from ..models.quant import dequant_tree
 
-                lp = dequant_tree(lp)
+                lp = dequant_tree(lp, keep_experts=cfg.is_moe)
                 a = _norm(cfg, lp["ln1"], h)
                 q, k, v = qkv_proj(cfg, lp["attn"], a)
                 if rope is not None:
@@ -353,7 +353,7 @@ class SpStageRunner:
                 from ..models.quant import dequant_tree
 
                 lp, (pk_l, pv_l, tk_l, tv_l) = lp
-                lp = dequant_tree(lp)
+                lp = dequant_tree(lp, keep_experts=cfg.is_moe)
                 a = _norm(cfg, lp["ln1"], h)
                 q, k, v = qkv_proj(cfg, lp["attn"], a)           # [B,1,H/Hkv,Dh]
                 if rope is not None:

@@ -22,6 +22,7 @@ module's body runs inside it over "tp".
 
 from __future__ import annotations
 
+import re
 from functools import partial
 from typing import Any, Dict, Optional
 
@@ -30,49 +31,67 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
-from ..models.partition import StageSpec, stage_forward
+from ..models.partition import (
+    StageSpec,
+    match_partition_rules,
+    path_name,
+    stage_forward,
+)
 
 Params = Dict[str, Any]
 
-# Leaf-name -> which axis of the [L, ...] stacked leaf is sharded (None =
-# replicated). Column-parallel in, row-parallel out; see module docstring.
-_DENSE_TP_AXIS = {
-    ("attn", "wq"): 2, ("attn", "wk"): 2, ("attn", "wv"): 2, ("attn", "wo"): 1,
-    ("attn", "bq"): 1, ("attn", "bk"): 1, ("attn", "bv"): 1, ("attn", "bo"): None,
-    ("mlp", "wg"): 2, ("mlp", "wu"): 2, ("mlp", "wd"): 1,      # swiglu
-    ("mlp", "wi"): 2, ("mlp", "wo"): 1,                         # gelu_mlp
-    ("mlp", "bi"): 1, ("mlp", "bo"): None,
-    ("ln1", "w"): None, ("ln1", "b"): None,
-    ("ln2", "w"): None, ("ln2", "b"): None,
-}
-# MoE experts: shard the expert axis (EP); router replicated.
-_MOE_TP_AXIS = {
-    ("mlp", "router"): None,
-    ("mlp", "wg"): 1, ("mlp", "wu"): 1, ("mlp", "wd"): 1,
-}
+
+def tp_partition_rules(cfg: ModelConfig, axis: str = "tp"):
+    """Explicit (regex, PartitionSpec) rules for stacked [L, ...] layer
+    leaves, consumed by `models.partition.match_partition_rules`.
+
+    Dense blocks: column-parallel in (q/k/v and mlp-in sharded on the
+    OUTPUT axis), row-parallel out (wo/wd sharded on the INPUT axis) — one
+    psum per matmul pair, emitted inside models.transformer. MoE blocks:
+    the expert axis (axis 1 of [L, E, ...]) shards over the SAME mesh axis
+    (expert parallelism) while the router stays replicated so top-k
+    routing and the sparse dispatch's capacity/drop decisions are global;
+    the per-token combine rides the same closing psum. Norms, biases
+    without a sharded sibling, and the per-layer `window` leaf replicate
+    via the catch-all."""
+    attn = (
+        (r"attn/(wq|wk|wv)$", P(None, None, axis)),
+        (r"attn/(bq|bk|bv)$", P(None, axis)),
+        (r"attn/wo$", P(None, axis)),
+    )
+    if cfg.is_moe:
+        mlp = (
+            (r"mlp/router$", P()),
+            (r"mlp/(wg|wu|wd)$", P(None, axis)),    # expert axis of [L,E,..]
+        )
+    else:
+        mlp = (
+            (r"mlp/(wg|wu|wi)$", P(None, None, axis)),
+            (r"mlp/(wd|wo|bi)$", P(None, axis)),
+        )
+    return (*attn, *mlp, (r".*", P()))
 
 
 def layer_partition_specs(cfg: ModelConfig, axis: str = "tp"):
     """Spec RESOLVER for stacked-layer leaves: returns a function
-    (tree_map_with_path path) -> PartitionSpec for a [L, ...] leaf. Use
-    `stage_param_specs` for a ready-made spec pytree over a whole stage."""
+    (tree_map_with_path path) -> PartitionSpec for a [L, ...] leaf, rule-
+    matched against `tp_partition_rules`. Use `stage_param_specs` for a
+    ready-made spec pytree over a whole stage."""
+    rules = tp_partition_rules(cfg, axis)
 
     def spec_for(path) -> P:
-        key = tuple(p.key for p in path[-2:])
-        table = _MOE_TP_AXIS if cfg.is_moe and key[0] == "mlp" else _DENSE_TP_AXIS
-        shard_axis = table.get(key)
-        if shard_axis is None:
-            return P()
-        parts = [None] * (shard_axis + 1)
-        parts[shard_axis] = axis
-        return P(*parts)
+        name = path_name(path)
+        for rule, spec in rules:
+            if re.search(rule, name):
+                return spec
+        return P()
 
     return spec_for
 
 
 def stage_param_specs(cfg: ModelConfig, params: Params, axis: str = "tp") -> Params:
     """PartitionSpec pytree for a stage's parameter shard: layer leaves get
-    the `_DENSE_TP_AXIS`/`_MOE_TP_AXIS` layout; embeddings, final norm, and
+    the `tp_partition_rules` layout; embeddings, final norm, and
     lm_head are replicated over the axis (the head's vocab matmul is
     recomputed identically on each rank — cheap next to the layer stack, and
     it keeps logits replicated for sampling). The single source of truth for
@@ -81,7 +100,7 @@ def stage_param_specs(cfg: ModelConfig, params: Params, axis: str = "tp") -> Par
     from ..models.quant import is_quantized
 
     if is_quantized(params):
-        # QuantizedTensor's q/s leaves would miss the name-keyed TP tables
+        # QuantizedTensor's q/s leaves would miss the name-keyed TP rules
         # and silently replicate — each rank would then compute the FULL
         # projection and the closing psum would multiply results by tp.
         # Fail loudly instead of corrupting logits.
@@ -90,13 +109,12 @@ def stage_param_specs(cfg: ModelConfig, params: Params, axis: str = "tp") -> Par
             "supported; shard full-precision params (quantize per shard "
             "afterwards if needed)"
         )
-    spec_for = layer_partition_specs(cfg, axis)
-
-    def f(path, _leaf):
-        top = path[0].key if path else None
-        return spec_for(path) if top == "layers" else P()
-
-    return jax.tree_util.tree_map_with_path(f, params)
+    out = {k: jax.tree.map(lambda _: P(), v)
+           for k, v in params.items() if k != "layers"}
+    if "layers" in params:
+        out["layers"] = match_partition_rules(
+            tp_partition_rules(cfg, axis), params["layers"])
+    return out
 
 
 def shard_stage_params(

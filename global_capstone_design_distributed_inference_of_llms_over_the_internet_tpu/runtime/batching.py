@@ -267,7 +267,7 @@ class BatchedStageExecutor:
             def layer(h, lp):
                 from ..models.quant import dequant_tree
 
-                lp = dequant_tree(lp)
+                lp = dequant_tree(lp, keep_experts=cfg.is_moe)
                 a = _norm(cfg, lp["ln1"], h)
                 q, k, v = qkv_proj(cfg, lp["attn"], a)
                 if rope is not None:
@@ -332,7 +332,7 @@ class BatchedStageExecutor:
                 from ..models.quant import dequant_tree
 
                 lp, k_l, v_l = xs                    # k_l: [M, Hkv, Dh]
-                lp = dequant_tree(lp)
+                lp = dequant_tree(lp, keep_experts=cfg.is_moe)
                 a = _norm(cfg, lp["ln1"], h)
                 q, k, v = qkv_proj(cfg, lp["attn"], a)
                 if rope is not None:
@@ -575,7 +575,7 @@ class BatchedStageExecutor:
                 lp, (k_l, v_l) = lp_kv                 # k_l: [S,max_len,Hkv,Dh]
                 from ..models.quant import dequant_tree
 
-                lp = dequant_tree(lp)
+                lp = dequant_tree(lp, keep_experts=cfg.is_moe)
                 a = _norm(cfg, lp["ln1"], h)
                 q, k, v = qkv_proj(cfg, lp["attn"], a)     # [S,T,H/Hkv,Dh]
                 if rope is not None:
@@ -734,7 +734,7 @@ class BatchedStageExecutor:
                     lp, (k_l, v_l) = lp_kv
                     from ..models.quant import dequant_tree
 
-                    lp = dequant_tree(lp)
+                    lp = dequant_tree(lp, keep_experts=cfg.is_moe)
                     a = _norm(cfg, lp["ln1"], h)
                     q, k, v = qkv_proj(cfg, lp["attn"], a)
                     if rope is not None:

@@ -39,6 +39,9 @@ FAST_BUCKETS = (
 FILL_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 64.0)
 # Route lengths (hops per planned pipeline).
 HOP_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)
+# MoE expert load relative to perfectly balanced routing (1.0 = uniform;
+# the top bucket catches a single expert absorbing ~everything).
+LOAD_BUCKETS = (0.0, 0.25, 0.5, 0.75, 1.0, 1.5, 2.0, 3.0, 4.0, 8.0)
 
 # name -> (kind, help, label names, histogram buckets or None)
 SPEC: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Sequence[float]]]] = {
@@ -229,6 +232,23 @@ SPEC: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Sequence[float]]]] = {
                "tenant and objective (ttft|token): 1.0 consumes the budget "
                "exactly at the target rate, >1.0 is on course to violate "
                "the SLO.", ("tenant", "objective"), None),
+    # -- sparse MoE dispatch (models/moe.py; recorded via jax.debug.callback
+    #    only when the registry was enabled at trace time) -------------------
+    "moe_expert_load": (
+        HISTOGRAM, "Per-expert routed-slot share relative to perfectly "
+                   "balanced load (1.0 = uniform; one observation per "
+                   "expert per dispatch).", (), LOAD_BUCKETS),
+    "moe_tokens_total": (
+        COUNTER, "Token-slots routed through sparse MoE dispatch "
+                 "(tokens x top_k).", (), None),
+    "moe_dropped_total": (
+        COUNTER, "Token-slots dropped because their expert overflowed its "
+                 "capacity C (divide by moe_tokens_total for the drop "
+                 "fraction).", (), None),
+    "moe_max_expert_share": (
+        GAUGE, "Hottest expert's share of the last dispatch's routed "
+               "slots (hot-expert skew; uniform = 1/num_experts).",
+        (), None),
     # -- phase profiler (--profile_phases) ------------------------------------
     "server_phase_seconds": (
         HISTOGRAM, "Serving hot-path phase wall time from the phase "

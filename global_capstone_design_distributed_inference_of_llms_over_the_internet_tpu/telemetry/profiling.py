@@ -71,6 +71,8 @@ DIGEST_FIELDS: Tuple[str, ...] = (
     "breaker_open",
     "cache_hit_ratio",
     "bubble_frac",
+    "moe_drop_frac",
+    "moe_hot_share",
     "uptime_s",
 )
 
@@ -318,6 +320,11 @@ def stats_digest(registry: Optional[MetricsRegistry] = None,
     hits = _metric_sum(reg, "server_prefix_cache_hits_total")
     misses = _metric_sum(reg, "server_prefix_cache_misses_total")
     lookups = hits + misses
+    # Sparse MoE dispatch health (models/moe.py): drop fraction over this
+    # process's lifetime, hottest expert's share of the last dispatch.
+    # Zero for dense models — the columns render "-"-free but inert.
+    routed = _metric_sum(reg, "moe_tokens_total")
+    dropped = _metric_sum(reg, "moe_dropped_total")
     return {
         "tok_s": round(meter.rate(tokens), 2),
         "tokens_total": tokens,
@@ -327,5 +334,7 @@ def stats_digest(registry: Optional[MetricsRegistry] = None,
                                     only_label=("state", "open")),
         "cache_hit_ratio": (hits / lookups) if lookups else 0.0,
         "bubble_frac": round(prof.bubble_fraction(), 4),
+        "moe_drop_frac": round((dropped / routed) if routed else 0.0, 4),
+        "moe_hot_share": round(_metric_sum(reg, "moe_max_expert_share"), 4),
         "uptime_s": round(reg.uptime_s(), 1),
     }

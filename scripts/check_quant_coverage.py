@@ -10,7 +10,12 @@ the unquantized baseline every row already is) must have:
   * a parity test: a ``"<fmt>"`` quantize under tests/ whose module
     asserts token equality against a dequantized/materialized reference
     (grepped as a quantize call in a tests/test_*.py file that also
-    contains a parity-style assertion).
+    contains a parity-style assertion);
+  * an MoE-path parity test: the same, in a module that exercises the
+    MoE layer stack (mentions mixtral/moe) — the sparse dispatch keeps
+    expert stacks PACKED (models/moe.py ``_expert_dot``), a separate code
+    path from the 2-D per-layer dequant the dense tests pin, so a format
+    can regress there while every dense parity test stays green.
 
 The format list is read from quant.py's SOURCE TEXT (regex, no import):
 quant.py pulls in jax at import time and this check must stay cheap
@@ -58,6 +63,7 @@ def main() -> int:
     fmts = quant_formats(QUANT.read_text(encoding="utf-8"))
     bench_cov = _quantize_calls(BENCH.read_text(encoding="utf-8"), fmts)
     parity_cov = set()
+    moe_cov = set()
     for p in TESTS:
         text = p.read_text(encoding="utf-8")
         # A parity module compares quantized serving against a dequantized
@@ -66,7 +72,12 @@ def main() -> int:
             continue
         if not re.search(r"assert .*==|assert_array_equal", text):
             continue
-        parity_cov |= _quantize_calls(text, fmts)
+        covered = _quantize_calls(text, fmts)
+        parity_cov |= covered
+        # The MoE-path requirement: the parity module must run the expert
+        # stack (mixtral config / moe module), not just dense layers.
+        if re.search(r"mixtral|moe", text, re.I):
+            moe_cov |= covered
     failed = False
     for fmt in fmts:
         missing = []
@@ -74,13 +85,16 @@ def main() -> int:
             missing.append("bench row in bench.py")
         if fmt not in parity_cov:
             missing.append("parity test under tests/")
+        if fmt not in moe_cov:
+            missing.append("MoE-path parity test under tests/ "
+                           "(mixtral/moe module)")
         if missing:
             failed = True
             print(f"quant format {fmt!r} (models/quant.py QUANT_BITS) "
                   f"lacks: {', '.join(missing)}")
     if not failed:
-        print(f"ok: all {len(fmts)} quant formats have bench rows and "
-              f"parity tests")
+        print(f"ok: all {len(fmts)} quant formats have bench rows, parity "
+              f"tests, and MoE-path parity tests")
     return 1 if failed else 0
 
 

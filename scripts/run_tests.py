@@ -58,7 +58,7 @@ SHARDS = [
      "test_fused_decode.py", "test_ici_pipeline.py", "test_int8_kernel.py",
      "test_kv_cache.py", "test_load_balancing.py"],
     # 3: oracles + registry + wire
-    ["test_metrics_documented.py", "test_models_oracle.py",
+    ["test_metrics_documented.py", "test_models_oracle.py", "test_moe.py",
      "test_multi_model.py", "test_net.py", "test_no_bare_print.py",
      "test_offload.py", "test_partition.py", "test_registry_ha.py"],
     # 4: protocol extensions
