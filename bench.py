@@ -889,6 +889,137 @@ def bench_gateway(cfg, params, *, splits=(6,), n_requests=8,
         reg_server.stop()
 
 
+def bench_relay(cfg, params, *, splits=(4,), max_new_tokens=12,
+                wire_dtype="f32", seed=0):
+    """Direct-vs-relayed serving pair (docs/PROTOCOL.md "NAT relay data
+    plane"): the SAME stage server generates once dialed directly, then
+    once through a relay volunteer (its record gains relay_via and its
+    advertised address becomes unroutable, so every frame provably rides
+    the volunteer's forward path). Structural, CPU-runnable: tokens must
+    be identical, the planner must charge the relayed route more, and the
+    measured relayed/direct ratio must stay inside a generous envelope of
+    the throughput model's RELAY_PENALTY — loopback adds one local
+    forward hop, so the measured ratio sits well above the modeled WAN
+    penalty; the assertion catches a relay path that's accidentally
+    quadratic, not one that's merely slower."""
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.partition import (
+        StagePlan,
+        slice_stage_params,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.client import (
+        PipelineClient,
+        make_server_record,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.executor import (
+        StageExecutor,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.runtime.net import (
+        TcpStageServer,
+        TcpTransport,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.ops.sampling import (
+        SamplingParams,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.scheduling.registry import (
+        PlacementRegistry,
+        ServerRecord,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.scheduling.routing import (
+        RouteHop,
+        route_cost,
+    )
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.scheduling.throughput import (
+        RELAY_PENALTY,
+    )
+
+    plan = StagePlan.from_splits(cfg.num_layers, list(splits))
+    rng = np.random.default_rng(seed)
+    prompt = rng.integers(0, cfg.vocab_size, 8).tolist()
+    sampling = SamplingParams(temperature=0.0)
+    registry = PlacementRegistry()
+    spec = plan.stages[1]
+    ex = StageExecutor(cfg, spec, slice_stage_params(cfg, params, spec),
+                       peer_id="bench-relay-s1")
+    srv = TcpStageServer(ex, host="127.0.0.1", port=0,
+                         wire_dtype=wire_dtype)
+    srv.start()
+    rec = make_server_record(ex.peer_id, spec)
+    rec.address = srv.address
+    registry.register(rec)
+    vol = TcpStageServer(None, host="127.0.0.1", port=0,
+                         wire_dtype=wire_dtype, peer_id="bench-relay-vol",
+                         relay_capacity=2)
+    vol.start()
+    registry.register(ServerRecord(peer_id="bench-relay-vol",
+                                   start_block=0, end_block=0,
+                                   address=vol.address, relay_capacity=2))
+    transports = []
+
+    def _run(tag):
+        tx = TcpTransport(registry, wire_dtype=wire_dtype)
+        transports.append(tx)
+        ex0 = StageExecutor(cfg, plan.stages[0],
+                            slice_stage_params(cfg, params, plan.stages[0]),
+                            peer_id=f"bench-relay-client-{tag}")
+        client = PipelineClient(cfg, plan, ex0, tx, registry,
+                                settle_seconds=0.0, seed=seed)
+        t0 = time.perf_counter()
+        res = client.generate(prompt, max_new_tokens=max_new_tokens,
+                              sampling=sampling,
+                              session_id=f"bench-relay-{tag}")
+        wall = time.perf_counter() - t0
+        return res.tokens, len(res.tokens) / wall
+
+    try:
+        direct_tokens, direct_tps = _run("direct")
+        # Flip the record relay-only: attach a circuit carrying the real
+        # bind address, advertise an unroutable one (the NAT model), and
+        # re-register with relay_via.
+        tx = TcpTransport(registry, wire_dtype=wire_dtype)
+        transports.append(tx)
+        tx.relay_attach("bench-relay-vol", ex.peer_id, srv.address)
+        rec.address = "127.0.0.1:9"
+        rec.relay_via = "bench-relay-vol"
+        registry.register(rec)
+        relayed_tokens, relayed_tps = _run("relayed")
+
+        direct_rec = ServerRecord(peer_id="d", start_block=spec.start,
+                                  end_block=spec.end, final_stage=True)
+        cost_direct = route_cost(
+            [RouteHop(direct_rec, spec.start, spec.end)])
+        cost_relayed = route_cost([RouteHop(rec, spec.start, spec.end)])
+        ratio = relayed_tps / direct_tps if direct_tps else 0.0
+        # Envelope: the model says a relayed peer is worth (1-RELAY_PENALTY)
+        # of a direct one on the WAN; on loopback the forward hop is cheap,
+        # so anything above a SLACK fraction of that floor is structurally
+        # sound. Token equality and planner ordering are the hard asserts.
+        floor = (1.0 - RELAY_PENALTY) * 0.25
+        return {
+            "tokens_per_s_direct": round(direct_tps, 2),
+            "tokens_per_s_relayed": round(relayed_tps, 2),
+            "relayed_to_direct_ratio": round(ratio, 3),
+            "tokens_identical": relayed_tokens == direct_tokens,
+            "route_cost_direct": round(cost_direct, 4),
+            "route_cost_relayed": round(cost_relayed, 4),
+            "planner_prefers_direct": cost_relayed > cost_direct,
+            "modeled_penalty": RELAY_PENALTY,
+            "within_envelope": ratio >= floor,
+            "ok": (relayed_tokens == direct_tokens
+                   and cost_relayed > cost_direct and ratio >= floor),
+            "note": ("same server dialed direct then via a relay "
+                     "volunteer on loopback; compare the ratio's shape, "
+                     "not WAN magnitude"),
+        }
+    finally:
+        for t in transports:
+            try:
+                t.close()
+            except Exception:
+                pass
+        srv.stop()
+        vol.stop()
+
+
 def bench_pipeline_microbatch(num_stages=4, micro_sizes=(1, 2, 4),
                               micro_batch=2, prefill=32, steps=8,
                               max_len=128, reps=2):
@@ -1778,6 +1909,10 @@ def main():
                                 max_new_tokens=4)
         except Exception as exc:   # the gateway row must not kill the smoke
             rgw = {"error": str(exc)[:200]}
+        try:
+            rrelay = bench_relay(cfg, params, splits=(2,), max_new_tokens=8)
+        except Exception as exc:   # the relay pair must not kill the smoke
+            rrelay = {"error": str(exc)[:200]}
         cfgs = {"smoke": r, "smoke_serving": rs, "smoke_serving_burst": rsb,
                 "smoke_int8_fold": rq8, "smoke_nf4_kernel": rq4,
                 "smoke_moe": rmoe,
@@ -1786,7 +1921,8 @@ def main():
                 "smoke_telemetry_overhead": rt,
                 "smoke_recorder_overhead": rrec,
                 "smoke_profiling": rprof,
-                "smoke_gateway": rgw}
+                "smoke_gateway": rgw,
+                "smoke_relay": rrelay}
         print(json.dumps({"metric": "smoke", "value": r["tokens_per_s"],
                           "unit": "tokens/s", "vs_baseline": 1.0,
                           "configs": cfgs}))

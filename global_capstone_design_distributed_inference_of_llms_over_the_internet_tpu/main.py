@@ -983,7 +983,8 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
                          wire_dtype=args.wire_dtype, model=_model_id(args),
                          runtime=runtime,
                          allow_fault_injection=args.allow_fault_injection,
-                         gossip=gnode)
+                         gossip=gnode,
+                         relay_capacity=args.relay_capacity)
     srv.start()
     # --public_ip overrides the advertised address (the reference's
     # public-maddr-only advertising, component 21 / src/main.py:492-509).
@@ -995,6 +996,45 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
                              engine=getattr(ex, "engine", "session"))
     rec.max_context = getattr(ex, "max_context", None)
     rec.address = advert
+    if args.relay_capacity > 0:
+        rec.relay_capacity = args.relay_capacity
+    # Next-hop RTT probe + relay attach share one transport: a TcpTransport
+    # resolves peers via the registry, so both hit the real data-plane wire.
+    from .runtime.net import TcpTransport as _TT
+    from .runtime.net import attach_via_relay as _attach_relay
+    from .runtime.net import check_direct_reachability as _reach
+    from .telemetry import events as _events
+
+    ping_tx = _TT(registry, wire_dtype=args.wire_dtype)
+    # Dial-back reachability vote (petals/server/reachability.py): ask live
+    # peers to dial `advert` back. An explicit False verdict means we are
+    # NAT'd — attach to a volunteer and advertise relay_via so clients
+    # route through it; None (nobody answered / first server in the swarm)
+    # is treated as reachable. The registration below then replicates
+    # relay_via through gossip like any other record field.
+    if _reach(ping_tx, registry, advert) is False:
+        got = _attach_relay(ping_tx, registry, ex.peer_id, srv.address)
+        if got is None:
+            _emit("WARNING: dial-back vote says this server is unreachable "
+                  "and no relay volunteer accepted an attach — clients "
+                  "will not be able to reach it (start a peer with "
+                  "--relay_capacity N or fix --public_ip)", flush=True)
+        else:
+            rec.relay_via = got["relay"]
+            # Advertise the relayed throughput through the same model the
+            # planner trusts: with step=None get_server_throughput returns
+            # the network-only estimate, so the relayed/direct ratio is
+            # exactly the RELAY_PENALTY discount (petals' use_relay wiring).
+            from .scheduling.throughput import get_server_throughput as _gst
+            nb = max(1, spec.end - spec.start)
+            direct_rps = _gst(None, cfg.hidden_size, num_blocks=nb)
+            relayed_rps = _gst(None, cfg.hidden_size, use_relay=True,
+                               num_blocks=nb)
+            rec.throughput = rec.throughput * (relayed_rps / direct_rps)
+            _events.emit("relay_attach", peer=ex.peer_id,
+                         relay=rec.relay_via, address=srv.address)
+            _emit(f"RELAY: serving via volunteer {rec.relay_via} "
+                  f"(dial-back vote failed for {advert})", flush=True)
     registry.register(rec)
     gnode.publish(_r2d(rec))
 
@@ -1020,12 +1060,9 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
     gloop.start()
     _emit(f"SERVING stage={args.stage} span=[{spec.start},{spec.end}) "
           f"addr={advert} peer={ex.peer_id}", flush=True)
-    # Next-hop RTT probe (petals/server/server.py:760-767): a TcpTransport
-    # resolves peers via the registry, so pings hit the real data-plane wire.
-    from .runtime.net import TcpTransport as _TT
+    # Next-hop RTT probe (petals/server/server.py:760-767) reuses ping_tx.
     from .runtime.server import measure_next_server_rtts as _rtts
 
-    ping_tx = _TT(registry, wire_dtype=args.wire_dtype)
     try:
         # Heartbeat every TTL/3 (src/main.py:529-537); re-register if the
         # registry restarted and forgot us.
@@ -1041,6 +1078,26 @@ def run_serve(args, cfg: ModelConfig, params) -> int:
                         cache_tokens_left=ex.arena.tokens_left(),
                         next_server_rtts=rtts):
                     registry.register(rec)
+                if rec.relay_via is not None:
+                    # Relay circuits are leases: re-attach every beat to
+                    # refresh ours (idempotent on the volunteer). If the
+                    # volunteer died, pick a replacement and re-advertise —
+                    # clients meanwhile hit the failover/replay path.
+                    from .runtime.net import PeerUnavailable as _PU
+                    try:
+                        ping_tx.relay_attach(rec.relay_via, ex.peer_id,
+                                             srv.address)
+                    except (_PU, TimeoutError, ConnectionError, OSError):
+                        got = _attach_relay(ping_tx, registry, ex.peer_id,
+                                            srv.address,
+                                            exclude=(rec.relay_via,))
+                        if got is not None:
+                            rec.relay_via = got["relay"]
+                            _events.emit("relay_attach", peer=ex.peer_id,
+                                         relay=rec.relay_via,
+                                         address=srv.address)
+                            registry.register(rec)
+                            gnode.publish(_r2d(rec))
                 # {} is published as-is: it RETRACTS stale RTTs (None would
                 # mean "no update" and pin dead-link measurements forever).
                 rtts = (None if spec.is_last else _rtts(
@@ -1747,6 +1804,223 @@ def registry_loss_soak(cfg, params, *, prompt_ids, max_new_tokens=8, seed=0,
     return result
 
 
+def relay_break_soak(cfg, params, *, prompt_ids, max_new_tokens=8, seed=0,
+                     splits=None, wire_dtype="f32", request_timeout=30.0,
+                     kill_after=2, sampling=None, stage_params=None) -> dict:
+    """Relay-death survival drill (--mode chaos --chaos_scenario relay_break).
+
+    Boots an in-process swarm where the FINAL stage server is NAT'd by
+    construction: it advertises an address nothing can dial (a closed local
+    port) and serves only through a relay volunteer. Two executor-less
+    volunteers stand by; the higher-capacity one wins the attach. The drill:
+
+      * clean run THROUGH the relay (the reference tokens — proving the
+        relayed data path is bit-identical to begin with);
+      * chaos run: the Nth stage-0 forward stops the active volunteer
+        mid-generation and re-attaches the NAT'd server to the standby
+        (exactly what its heartbeat re-pick does, compressed in time);
+      * the generation must finish with IDENTICAL tokens — the client's
+        normal failover/replay path re-resolves the hop through the new
+        volunteer;
+      * the circuit breaker must blame the dead VOLUNTEER, not the relayed
+        peer (one dead relay must not blacklist every peer behind it);
+      * the doctor must reconstruct the incident as one failure chain:
+        relay lost -> failover -> replay.
+    """
+    from .runtime.executor import StageExecutor as _SE
+    from .runtime.net import (RegistryServer, RemoteRegistry, TcpStageServer,
+                              TcpTransport, attach_via_relay)
+    from .runtime.task_pool import StageRuntime
+    from .telemetry import doctor as _doc
+    from .telemetry import events as _events
+
+    _events.get_recorder().enable()
+    if sampling is None:
+        sampling = SamplingParams(temperature=0.0)
+    if stage_params is None:
+        stage_params = lambda spec: slice_stage_params(cfg, params, spec)  # noqa: E731
+    plan = (StagePlan.from_splits(cfg.num_layers, splits) if splits
+            else StagePlan.even(cfg.num_layers, 4))
+
+    problems: List[str] = []
+    result: dict = {"seed": seed}
+    registries: List[RegistryServer] = []
+    servers: List[TcpStageServer] = []
+    transports: List[TcpTransport] = []
+    try:
+        rs = RegistryServer(host="127.0.0.1", port=0)
+        rs.start()
+        registries.append(rs)
+        reg = RemoteRegistry(rs.address, timeout=2.0)
+
+        # --- two relay volunteers: pure forwarders, no stage span. Their
+        # records carry an EMPTY span (never routed stage traffic) plus
+        # relay_capacity, exactly what attach_via_relay's picker keys on;
+        # v1's larger capacity makes it the deterministic first choice. ---
+        from .scheduling.registry import ServerRecord as _SR
+
+        vols = {}
+        for vid, cap in (("relay-v1", 4), ("relay-v2", 2)):
+            vsrv = TcpStageServer(None, host="127.0.0.1", port=0,
+                                  wire_dtype=wire_dtype, peer_id=vid,
+                                  relay_capacity=cap)
+            vsrv.start()
+            vrec = _SR(peer_id=vid, start_block=0, end_block=0,
+                       address=vsrv.address, relay_capacity=cap)
+            reg.register(vrec)
+            servers.append(vsrv)
+            vols[vid] = vsrv
+
+        # --- stage swarm; the FINAL stage is the NAT'd server ---
+        nat_spec = plan.stages[-1]
+        nat_rec = None
+        nat_srv = None
+        for spec in plan.stages[1:]:
+            ex = _SE(cfg, spec, stage_params(spec),
+                     peer_id=f"rbreak-s{spec.index}")
+            srv = TcpStageServer(ex, host="127.0.0.1", port=0,
+                                 wire_dtype=wire_dtype,
+                                 runtime=StageRuntime())
+            srv.start()
+            rec = make_server_record(ex.peer_id, spec)
+            if spec is nat_spec:
+                # Advertise a closed port: any DIRECT dial fails instantly,
+                # so a passing run proves every frame rode the relay.
+                rec.address = "127.0.0.1:9"
+                nat_rec, nat_srv = rec, srv
+            else:
+                rec.address = srv.address
+            reg.register(rec)
+            servers.append(srv)
+
+        # --- the NAT'd server attaches (run_serve's post-vote path) ---
+        atx = TcpTransport(reg, wire_dtype=wire_dtype)
+        transports.append(atx)
+        got = attach_via_relay(atx, reg, nat_rec.peer_id, nat_srv.address)
+        if got is None or got["relay"] != "relay-v1":
+            problems.append(f"attach picked {got and got['relay']}, "
+                            "want relay-v1 (highest spare capacity)")
+            result["problems"] = problems
+            result["ok"] = False
+            return result
+        nat_rec.relay_via = got["relay"]
+        _events.emit("relay_attach", peer=nat_rec.peer_id,
+                     relay=nat_rec.relay_via, address=nat_srv.address)
+        reg.register(nat_rec)
+
+        ex0 = _SE(cfg, plan.stages[0], stage_params(plan.stages[0]),
+                  peer_id="rbreak-client")
+
+        def _client(tx, stage0):
+            return PipelineClient(cfg, plan, stage0, tx, reg,
+                                  request_timeout=request_timeout,
+                                  settle_seconds=0.0, seed=seed)
+
+        # --- clean reference run, THROUGH the relay ---
+        tx1 = TcpTransport(reg, wire_dtype=wire_dtype)
+        transports.append(tx1)
+        clean = _client(tx1, ex0).generate(
+            list(prompt_ids), max_new_tokens, sampling=sampling,
+            session_id="rbreak-clean")
+        result["tokens_clean"] = list(clean.tokens)
+
+        # --- chaos run: Nth stage-0 forward kills the active volunteer ---
+        class _KillSwitch:
+            """Stage-0 proxy that trips `kill` after the Nth forward, so the
+            relay dies DETERMINISTICALLY mid-generation (after prefill,
+            before the decode steps finish)."""
+
+            def __init__(self, inner, after_n, kill):
+                self._inner, self._after, self._kill = inner, after_n, kill
+                self.calls = 0
+
+            def forward(self, req):
+                out = self._inner.forward(req)
+                self.calls += 1
+                if self.calls == self._after:
+                    self._kill()
+                return out
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        def _break_relay():
+            vols["relay-v1"].stop()
+            # The NAT'd server's heartbeat re-pick, compressed in time:
+            # re-attach via the standby and re-advertise relay_via. The
+            # in-flight client meanwhile takes the failover/replay path.
+            got2 = attach_via_relay(atx, reg, nat_rec.peer_id,
+                                    nat_srv.address, exclude=("relay-v1",))
+            if got2 is not None:
+                nat_rec.relay_via = got2["relay"]
+                _events.emit("relay_attach", peer=nat_rec.peer_id,
+                             relay=nat_rec.relay_via,
+                             address=nat_srv.address)
+                reg.register(nat_rec)
+
+        tx2 = TcpTransport(reg, wire_dtype=wire_dtype)
+        transports.append(tx2)
+        cl2 = _client(tx2, _KillSwitch(ex0, kill_after, _break_relay))
+        chaos = cl2.generate(list(prompt_ids), max_new_tokens,
+                             sampling=sampling, session_id="rbreak-chaos")
+        result["tokens_chaos"] = list(chaos.tokens)
+        result["relay_after"] = nat_rec.relay_via
+        result["recoveries"] = cl2.recoveries
+        if list(clean.tokens) != list(chaos.tokens):
+            problems.append(
+                "token divergence across the relay kill: "
+                f"clean={list(clean.tokens)} chaos={list(chaos.tokens)}")
+        if nat_rec.relay_via != "relay-v2":
+            problems.append(
+                f"re-attach landed on {nat_rec.relay_via}, want relay-v2")
+        if cl2.recoveries < 1:
+            problems.append(
+                "client reported no recoveries — the kill never landed "
+                "mid-generation (raise max_new_tokens or lower kill_after)")
+
+        # --- blame: the breaker must track the VOLUNTEER, not the peer ---
+        if not cl2.breaker.allow(nat_rec.peer_id):
+            problems.append(
+                "circuit breaker opened for the RELAYED peer "
+                f"{nat_rec.peer_id}; the dead volunteer relay-v1 should "
+                "have taken the blame")
+
+        # --- doctor: the incident must read as ONE failure chain ---
+        streams = [{"meta": {"pid": os.getpid()},
+                    "events": [ev.to_dict()
+                               for ev in _events.get_recorder().events()]}]
+        chains = _doc.failure_chains(_doc.merge_timeline(streams))
+        result["chains"] = len(chains)
+        ok_chain = False
+        for ch in chains:
+            names = {ev.get("event") for ev in ch["events"]}
+            if {"relay_forward_error", "failover", "replay_done"} <= names:
+                ok_chain = True
+        if not ok_chain:
+            problems.append(
+                "doctor chains do not reconstruct the incident (want one "
+                "chain with relay_forward_error + failover + replay_done)")
+    finally:
+        for tx in transports:
+            try:
+                tx.close()
+            except Exception:
+                pass
+        for srv in servers:
+            try:
+                srv.stop()
+            except Exception:
+                pass
+        for rs in registries:
+            try:
+                rs.stop()
+            except Exception:
+                pass
+    result["problems"] = problems
+    result["ok"] = not problems
+    return result
+
+
 def overload_soak(cfg, params, *, prompt_ids, max_new_tokens=8, seed=0,
                   splits=None, wire_dtype="f32", request_timeout=30.0,
                   requests_per_tenant=3, stage_params=None,
@@ -2089,6 +2363,31 @@ def run_chaos(args, cfg: ModelConfig, params) -> int:
         for p in res["problems"]:
             _emit(f"REGISTRY-LOSS SOAK FAIL: {p}")
         return 1
+    if args.chaos_scenario == "relay_break":
+        if args.chaos_attach:
+            _emit("RELAY-BREAK SOAK FAIL: --chaos_scenario relay_break "
+                  "boots its own swarm (it must own the volunteer it "
+                  "kills); drop --chaos_attach")
+            return 1
+        res = relay_break_soak(
+            cfg, params, prompt_ids=prompt_ids,
+            max_new_tokens=args.max_new_tokens, seed=args.seed,
+            splits=splits, wire_dtype=args.wire_dtype,
+            request_timeout=args.request_timeout)
+        _emit(f"\n=== Relay-break soak (seed={res['seed']}) ===")
+        _emit(f"tokens (clean, via relay) : {res.get('tokens_clean')}")
+        _emit(f"tokens (chaos)            : {res.get('tokens_chaos')}")
+        _emit(f"relay after failover      : {res.get('relay_after')}")
+        _emit(f"client recoveries         : {res.get('recoveries')}")
+        _emit(f"failure chains            : {res.get('chains', 0)}")
+        if res["ok"]:
+            _emit("RELAY-BREAK SOAK PASS: identical tokens across the "
+                  "relay kill; breaker blamed the volunteer; doctor "
+                  "reconstructed relay lost -> failover -> replay")
+            return 0
+        for p in res["problems"]:
+            _emit(f"RELAY-BREAK SOAK FAIL: {p}")
+        return 1
     if args.chaos_scenario == "overload":
         if args.chaos_attach:
             _emit("OVERLOAD SOAK FAIL: --chaos_scenario overload boots its "
@@ -2342,6 +2641,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--public_ip", default=None,
                    help="serve mode: advertise this IP instead of --host")
+    p.add_argument("--relay_capacity", type=int, default=0,
+                   help="serve mode: volunteer to relay traffic for up to N "
+                        "NAT'd peers that fail the dial-back reachability "
+                        "vote (0 = do not volunteer). Attach requests "
+                        "beyond N are shed so load spreads across "
+                        "volunteers")
     p.add_argument("--peer_id", default=None)
     p.add_argument("--ttl", type=float, default=45.0,
                    help="registry mode: record TTL seconds (reference 45s); "
@@ -2353,7 +2658,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "on a production swarm — it lets any client that "
                         "can dial the port inject faults")
     p.add_argument("--chaos_scenario",
-                   choices=["faults", "registry_loss", "overload"],
+                   choices=["faults", "registry_loss", "overload",
+                            "relay_break"],
                    default="faults",
                    help="chaos mode: 'faults' runs the seeded fault-"
                         "injection soak; 'registry_loss' kills the primary "
@@ -2720,9 +3026,9 @@ def _render_top(rows: list, source: str, gateway: Optional[dict]) -> str:
     """One ``--mode top`` frame: a whole-swarm stats table plus (when a
     gateway answered) per-tenant SLO burn rates."""
     lines = [f"swarm top — {len(rows)} server(s) (source: {source})"]
-    hdr = (f"{'PEER':<14} {'SPAN':<10} {'TOK/S':>8} {'QUEUE':>6} "
-           f"{'BRK':>4} {'CACHE%':>7} {'BUBBLE%':>8} {'DROP%':>6} "
-           f"{'HOT%':>5} {'UP(S)':>8}")
+    hdr = (f"{'PEER':<14} {'SPAN':<10} {'RELAY':<10} {'TOK/S':>8} "
+           f"{'QUEUE':>6} {'BRK':>4} {'CACHE%':>7} {'BUBBLE%':>8} "
+           f"{'DROP%':>6} {'HOT%':>5} {'UP(S)':>8}")
     lines.append(hdr)
     lines.append("-" * len(hdr))
 
@@ -2739,8 +3045,11 @@ def _render_top(rows: list, source: str, gateway: Optional[dict]) -> str:
                                            str(r.get("peer_id")))):
         stats = row.get("stats")
         span = f"[{row.get('start_block', '?')},{row.get('end_block', '?')})"
+        # NAT'd servers show WHO forwards for them; direct ones a dash.
+        relay = str(row.get("relay_via") or "-")
         lines.append(
             f"{str(row.get('peer_id', '?')):<14} {span:<10} "
+            f"{relay:<10} "
             f"{_f(stats, 'tok_s'):>8} "
             f"{_f(stats, 'queue_depth', fmt='{:.0f}'):>6} "
             f"{_f(stats, 'breaker_open', fmt='{:.0f}'):>4} "
@@ -2781,6 +3090,7 @@ def _collect_top(args) -> Tuple[list, str, Optional[dict]]:
     for r in records:
         d = {"peer_id": r.peer_id, "address": r.address,
              "start_block": r.start_block, "end_block": r.end_block,
+             "relay_via": getattr(r, "relay_via", None),
              "stats": None}
         rows[r.peer_id] = d
     snap = _PR()
@@ -2807,6 +3117,8 @@ def _collect_top(args) -> Tuple[list, str, Optional[dict]]:
                 row["start_block"] = rec.get("start_block",
                                              row.get("start_block"))
                 row["end_block"] = rec.get("end_block", row.get("end_block"))
+                row["relay_via"] = rec.get("relay_via",
+                                           row.get("relay_via"))
                 if isinstance(rec.get("stats"), dict):
                     row["stats"] = rec["stats"]
             # The answering peer's own digest is fresher than its

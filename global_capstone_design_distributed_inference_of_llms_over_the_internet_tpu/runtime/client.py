@@ -775,8 +775,13 @@ class PipelineClient:
             except (PeerUnavailable, TimeoutError, ConnectionError,
                     StageExecutionError) as exc:
                 if not isinstance(exc, _BreakerOpen):
-                    # A skipped dial is not evidence about the peer.
-                    self.breaker.record_failure(hop.peer_id)
+                    # A skipped dial is not evidence about the peer. Breaker
+                    # blame may differ from routing blame: a RELAYED hop's
+                    # failure is usually its volunteer's (breaker_peer_id)
+                    # — opening the hop's own breaker would blacklist every
+                    # peer behind one dead relay.
+                    self.breaker.record_failure(
+                        getattr(exc, "breaker_peer_id", None) or hop.peer_id)
                 last_exc = exc
                 self._m_retries.inc()
                 trace_id = (req.trace or {}).get("trace_id") \
@@ -1018,12 +1023,22 @@ class PipelineClient:
         nxt = []
         for h in hops[1:]:
             rec = self.registry.get(h.peer_id)
-            nxt.append({
+            entry = {
                 "peer_id": h.peer_id,
                 "address": getattr(rec, "address", None) if rec else None,
                 "start_block": h.start_block,
                 "end_block": h.end_block,
-            })
+            }
+            via = getattr(rec, "relay_via", None) if rec else None
+            if via:
+                # NAT'd next hop: the pushing server must dial its relay
+                # VOLUNTEER and stamp relay_to (TcpStageServer._relay does,
+                # keyed on relay_via) — the hop's own address is unreachable.
+                rrec = self.registry.get(via)
+                entry["relay_via"] = via
+                entry["address"] = getattr(rrec, "address", None) \
+                    if rrec else None
+            nxt.append(entry)
         return StageRequest(
             session_id=session_id, hidden=hidden, seq_len=seq_len,
             cur_len=cur_len, is_prefill=is_prefill, is_replay=is_replay,
@@ -1179,8 +1194,15 @@ class PipelineClient:
                 raise  # terminal: retrying spends a budget already blown
             except (PeerUnavailable, TimeoutError, ConnectionError,
                     StageExecutionError) as exc:
+                # Breaker blame prefers the failing COMPONENT over the
+                # routing-blamed hop: a PushChainError whose breaker_peer_id
+                # names a relay volunteer opens the VOLUNTEER's breaker (the
+                # relayed peer behind it may be perfectly healthy), while
+                # _blame_chain_failure below still blacklists the hop so the
+                # next route avoids it.
                 self.breaker.record_failure(
-                    getattr(exc, "peer_id", None) or hops[0].peer_id)
+                    getattr(exc, "breaker_peer_id", None)
+                    or getattr(exc, "peer_id", None) or hops[0].peer_id)
                 chain_span.end(error=repr(exc))
                 last_exc = exc
                 self._m_retries.inc()

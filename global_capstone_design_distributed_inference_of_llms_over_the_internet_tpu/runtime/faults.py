@@ -54,6 +54,14 @@ Fault kinds (`FaultRule.kind`):
                           no new kind — a ``delay``/``accept_hang`` rule
                           with ``verb="gossip"`` rides the generic
                           dispatch hook.
+  ``relay_drop``          a relay volunteer drops the frame it was asked
+                          to forward on behalf of a NAT'd peer and answers
+                          with the push-chain error shape instead (blaming
+                          itself via ``breaker_peer`` — the relayed peer's
+                          breaker must stay closed);
+  ``relay_stall``         the volunteer sleeps ``delay_s`` before
+                          forwarding — a congested relay; the frame still
+                          arrives and no failover is required.
 
 Determinism: matching is pure counting (per-rule ``nth``/``every``/
 ``times``) plus an RNG seeded at plan construction for ``prob`` rules and
@@ -92,6 +100,8 @@ KINDS = (
     "duplicate",
     "stale_registry",
     "gossip_drop",
+    "relay_drop",
+    "relay_stall",
 )
 
 # Which sites can act on which kinds (documentation + validation; the call
@@ -112,6 +122,11 @@ SITE_KINDS = {
     # duplicate merges the delta twice (anti-entropy merge is idempotent;
     # this proves it on the wire).
     "gossip": ("gossip_drop", "duplicate"),
+    # The relay seam is the volunteer's forward site (`TcpStageServer.
+    # _relay_forward`): after the generic dispatch hooks, before the pooled
+    # dial to the relayed peer. `peer` matches the relayed TARGET (not the
+    # client), so a rule can break one NAT'd peer's circuit specifically.
+    "relay": ("relay_drop", "relay_stall"),
 }
 
 SIDES = ("client", "server", "registry")

@@ -98,7 +98,11 @@ class StageRequest:
     # directly to next_servers[0] (relaying the eventual final response back
     # up) instead of bouncing through the client — one client round trip per
     # step instead of one per hop. Entries: {peer_id, address?, start_block,
-    # end_block}.
+    # end_block}. A NAT'd hop's entry additionally carries relay_via (its
+    # volunteer's peer_id) with address OVERRIDDEN to the volunteer's — the
+    # pushing server dials the volunteer and stamps relay_to, exactly like
+    # a client would, and push-chain error frames for that hop split
+    # routing blame (peer) from breaker blame (breaker_peer).
     next_servers: Tuple[dict, ...] = ()
     # Prompt-prefix sharing (runtime.prefix_cache; no reference
     # counterpart): on a PREFILL, the client marks the leading prefix_len

@@ -583,6 +583,11 @@ class TcpStageServer(_FramedTcpServer):
     process boundary. Without one, compute runs on the handler thread
     (single-client deployments)."""
 
+    # Relay circuit lease (seconds): an attached NAT'd peer must re-attach
+    # (its heartbeat loop does, idempotently) within this window or the
+    # volunteer reclaims the slot — a dead relayed peer never pins capacity.
+    RELAY_CIRCUIT_TTL = 90.0
+
     def __init__(self, executor: Optional[StageExecutor],
                  host: str = "127.0.0.1",
                  port: int = 0, wire_dtype: str = "bf16",
@@ -592,7 +597,8 @@ class TcpStageServer(_FramedTcpServer):
                  peer_id: Optional[str] = None,
                  model: Optional[str] = None,
                  allow_fault_injection: bool = False,
-                 gossip: Optional[GossipNode] = None):
+                 gossip: Optional[GossipNode] = None,
+                 relay_capacity: int = 0):
         # May be swapped at runtime (elastic servers re-span in place) or
         # None during a re-span window — requests then get a retryable
         # stage error and clients fail over / retry.
@@ -619,6 +625,15 @@ class TcpStageServer(_FramedTcpServer):
         # addr -> (socket, per-connection send/recv lock)
         self._relay_conns: Dict[str, tuple] = {}
         self._relay_lock = threading.Lock()
+        # NAT relay volunteering (petals/server/reachability.py): how many
+        # unreachable peers this server will forward for (0 = not a
+        # volunteer; attaches beyond capacity are shed with an error frame).
+        # _relay_targets maps an attached peer_id -> (its relay-dialable
+        # address, circuit expiry). Circuits are leases: the relayed peer
+        # re-attaches on its heartbeat cadence, so a dead peer's slot frees
+        # itself and capacity is never permanently consumed.
+        self.relay_capacity = int(relay_capacity)
+        self._relay_targets: Dict[str, tuple] = {}
         # Persistent inference streams (petals handler.py:132-308): per
         # CONNECTION, session_id -> stream state (metadata shipped once at
         # stream_open; steady-state steps carry only deltas). Keyed by the
@@ -661,6 +676,17 @@ class TcpStageServer(_FramedTcpServer):
             raise ConnectionError(f"no address for push target {nxt}")
         arr = np.asarray(nreq.hidden)
         meta, body = _encode_tensor(arr, self.wire_dtype)
+        # Propagate the ORIGINATING client's tag when it has one — an
+        # untagged legacy hop relaying with only self.model (None) would
+        # strip the tag from the rest of the chain.
+        hdr = _request_header(
+            nreq, meta,
+            model=(nreq.model if nreq.model is not None else self.model))
+        if nxt.get("relay_via"):
+            # NAT'd next hop: `addr` is its relay VOLUNTEER's address (the
+            # route planner resolved it); relay_to tells the volunteer which
+            # attached circuit this frame is for.
+            hdr["relay_to"] = nxt.get("peer_id")
         # The downstream response covers the REST of the chain's computes.
         timeout = self.compute_timeout * (1 + len(nreq.next_servers))
         for fresh in (False, True):
@@ -670,15 +696,7 @@ class TcpStageServer(_FramedTcpServer):
                 # the same next hop must not interleave frames on one socket.
                 with lock:
                     sock.settimeout(timeout)
-                    # Propagate the ORIGINATING client's tag when it has one
-                    # — an untagged legacy hop relaying with only self.model
-                    # (None) would strip the tag from the rest of the chain.
-                    _send_frame(sock,
-                                _request_header(
-                                    nreq, meta,
-                                    model=(nreq.model if nreq.model is not None
-                                           else self.model)),
-                                body)
+                    _send_frame(sock, hdr, body)
                     return _recv_frame(sock)
             except (ConnectionError, OSError):
                 self._drop_relay(addr, sock)
@@ -725,6 +743,129 @@ class TcpStageServer(_FramedTcpServer):
             sock.close()
         except OSError:
             pass
+
+    # ------------------------------------------------------------------
+    # NAT relay volunteering (petals/server/reachability.py)
+    # ------------------------------------------------------------------
+
+    def _prune_relay_targets_locked(self, now: float) -> None:
+        expired = [p for p, (_, exp) in self._relay_targets.items()
+                   if now >= exp]
+        for p in expired:
+            del self._relay_targets[p]
+
+    def _relay_attach(self, sock, header: dict) -> None:
+        """Open (or refresh) a relay circuit for an unreachable peer. The
+        peer sends the address the VOLUNTEER can dial it at — typically its
+        bind address, reachable from inside the NAT while its advertised
+        address is not. Saturated volunteers shed with an error frame so the
+        attacher moves on to the next candidate."""
+        peer = header.get("peer_id")
+        addr = header.get("address")
+        if not peer or not addr:
+            _send_frame(sock, {"verb": "error",
+                               "message": "relay_attach needs peer_id "
+                                          "and address"})
+            return
+        now = time.monotonic()
+        with self._relay_lock:
+            self._prune_relay_targets_locked(now)
+            if (peer not in self._relay_targets
+                    and len(self._relay_targets) >= self.relay_capacity):
+                active = len(self._relay_targets)
+                saturated = True
+            else:
+                self._relay_targets[peer] = (addr,
+                                             now + self.RELAY_CIRCUIT_TTL)
+                active = len(self._relay_targets)
+                saturated = False
+        _tm.get("relay_active_circuits").set(active)
+        if saturated:
+            _send_frame(sock, {"verb": "error", "relay_saturated": True,
+                               "peer": self.peer_id or "?",
+                               "message": f"relay at capacity "
+                                          f"({active}/{self.relay_capacity})"})
+            return
+        _send_frame(sock, {"verb": "ok", "peer": self.peer_id or "?",
+                           "active": active,
+                           "capacity": self.relay_capacity,
+                           "ttl": self.RELAY_CIRCUIT_TTL})
+
+    def _relay_forward(self, sock, target: str, header: dict,
+                       payload: bytes) -> None:
+        """Forward a client frame verbatim to attached peer `target` over the
+        pooled `_relay_conns` circuit and relay the response frame back.
+        Failures answer with the push-chain error shape: `peer`=target keeps
+        the CLIENT's routing blame on the unreachable hop, while the circuit
+        breaker opens only where `breaker_peer` says the fault actually is."""
+        verb = header.get("verb")
+        session = header.get("session_id")
+        m_fwd = _tm.get("relay_forwarded_total")
+        plan = self.fault_plan
+        if plan is not None:
+            rule = plan.fire("relay", SITE_KINDS["relay"],
+                             side=self.fault_side, peer=target, verb=verb,
+                             session=session)
+            if rule is not None:
+                if rule.kind == "relay_stall":
+                    time.sleep(rule.delay_s)
+                else:  # relay_drop: the volunteer eats the frame
+                    m_fwd.labels(outcome="drop").inc()
+                    _ev.emit("relay_forward_error", session_id=session,
+                             relay=self.peer_id or "?", peer=target,
+                             verb=verb, error="relay_drop (injected)")
+                    _send_frame(sock, {
+                        "verb": "error", "kind": "push", "peer": target,
+                        "breaker_peer": self.peer_id or "?",
+                        "message": f"relay dropped frame for {target} "
+                                   f"(injected)"})
+                    return
+        now = time.monotonic()
+        with self._relay_lock:
+            self._prune_relay_targets_locked(now)
+            entry = self._relay_targets.get(target)
+            active = len(self._relay_targets)
+        _tm.get("relay_active_circuits").set(active)
+        if entry is None:
+            # No circuit: the peer never attached here (stale record) or its
+            # lease lapsed (it stopped heartbeating — probably dead). Either
+            # way the TARGET is the unhealthy component, not this volunteer.
+            m_fwd.labels(outcome="no_circuit").inc()
+            _ev.emit("relay_forward_error", session_id=session,
+                     relay=self.peer_id or "?", peer=target, verb=verb,
+                     error="no circuit")
+            _send_frame(sock, {
+                "verb": "error", "kind": "push", "peer": target,
+                "message": f"no relay circuit for {target}"})
+            return
+        addr = entry[0]
+        # The relayed peer's compute is on the far side of this forward;
+        # budget like a push hop (chained verbs carry their own chain).
+        timeout = self.compute_timeout * (
+            1 + len(header.get("next_servers") or ()))
+        for fresh in (False, True):
+            fsock = None
+            try:
+                fsock, lock = self._relay_sock(addr, fresh)
+                with lock:
+                    fsock.settimeout(timeout)
+                    _send_frame(fsock, header, payload)
+                    rh, rp = _recv_frame(fsock)
+                break
+            except (ConnectionError, OSError, socket.timeout) as exc:
+                if fsock is not None:
+                    self._drop_relay(addr, fsock)
+                if fresh:
+                    m_fwd.labels(outcome="error").inc()
+                    _ev.emit("relay_forward_error", session_id=session,
+                             relay=self.peer_id or "?", peer=target,
+                             verb=verb, error=str(exc)[:200])
+                    _send_frame(sock, {
+                        "verb": "error", "kind": "push", "peer": target,
+                        "message": f"relay to {target} failed: {exc}"})
+                    return
+        m_fwd.labels(outcome="ok").inc()
+        _send_frame(sock, rh, rp)
 
     def start(self) -> None:
         super().start()
@@ -809,6 +950,20 @@ class TcpStageServer(_FramedTcpServer):
 
     def _dispatch(self, sock, header: dict, payload: bytes) -> None:
         verb = header.get("verb")
+        relay_to = header.pop("relay_to", None)
+        if relay_to is not None:
+            # We are this frame's relay VOLUNTEER, not its destination:
+            # forward it verbatim (minus the routing key) over the pooled
+            # circuit to the attached NAT'd peer and stream the response
+            # back. Runs before every other verb — any verb can be relayed —
+            # and needs no executor (a pure volunteer serves no blocks).
+            self._relay_forward(sock, relay_to, header, payload)
+            return
+        if verb == "relay_attach":
+            # Circuit setup from an unreachable peer. Executor-less on
+            # purpose: volunteering is a socket-plane capability.
+            self._relay_attach(sock, header)
+            return
         if verb == "reach_check":
             # Socket-only probe — needs no executor, so a re-spanning server
             # still answers reachability votes for its peers.
@@ -1232,11 +1387,19 @@ class TcpStageServer(_FramedTcpServer):
                 rh, rp = self._relay(nxt, nreq)
             except (ConnectionError, OSError, TimeoutError) as exc:
                 m_requests.labels(outcome="error").inc()
-                _send_frame(sock, {
+                err = {
                     "verb": "error", "kind": "push",
                     "peer": nxt.get("peer_id", "?"),
                     "message": f"push to {nxt.get('peer_id')} failed: {exc}",
-                })
+                }
+                if nxt.get("relay_via"):
+                    # The dial that failed was to the next hop's relay
+                    # VOLUNTEER, not the hop itself: blame the hop for
+                    # routing (`peer` — the client routes around it) but the
+                    # volunteer for the circuit breaker, so one dead relay
+                    # doesn't blacklist every peer behind it.
+                    err["breaker_peer"] = nxt.get("relay_via")
+                _send_frame(sock, err)
                 return
             if stream is not None and rh.get("verb") == "token" and (
                     rh.get("token_id") is not None):
@@ -1402,6 +1565,11 @@ class TcpTransport(Transport):
         self._conns: Dict[str, socket.socket] = {}
         # (peer_id, session_id) -> {"snap", "sock", "window", "returns_tokens"}
         self._streams: Dict[Tuple[str, str], dict] = {}
+        # peer_id -> relay volunteer's peer_id when the peer is NAT'd
+        # (record carries relay_via); refreshed by _addr at dial time. The
+        # pool key stays the TARGET peer: each relayed peer gets its own
+        # socket to the volunteer, preserving per-peer stream semantics.
+        self._via_relay: Dict[str, Optional[str]] = {}
         self._lock = threading.Lock()
         # Chaos layer (runtime.faults): client-side injection hook. None
         # (default) keeps dial/send on raw sockets; arm via set_fault_plan.
@@ -1430,8 +1598,32 @@ class TcpTransport(Transport):
         rec = self.registry.get(peer_id)
         if rec is None or not rec.address:
             raise PeerUnavailable(f"no address for peer {peer_id}")
-        host, port = rec.address.rsplit(":", 1)
+        addr = rec.address
+        via = getattr(rec, "relay_via", None)
+        if via:
+            # NAT'd peer: its own address is unreachable by construction —
+            # dial its relay VOLUNTEER instead and let _send stamp frames
+            # with relay_to so the volunteer forwards them verbatim.
+            rrec = self.registry.get(via)
+            if rrec is None or not rrec.address:
+                raise PeerUnavailable(
+                    f"no address for relay {via} of peer {peer_id}")
+            addr = rrec.address
+        with self._lock:
+            self._via_relay[peer_id] = via
+        host, port = addr.rsplit(":", 1)
         return host, int(port)
+
+    def _send(self, peer_id: str, sock, hdr: dict, body: bytes = b"") -> None:
+        """Single choke point for request frames to `peer_id`: a peer served
+        through a relay volunteer (we dialed the volunteer in _addr) gets
+        every frame stamped with relay_to, whatever the verb — the relay
+        data plane is verb-transparent by construction."""
+        with self._lock:
+            via = self._via_relay.get(peer_id)
+        if via:
+            hdr["relay_to"] = peer_id
+        _send_frame(sock, hdr, body)
 
     def _connect(self, peer_id: str) -> socket.socket:
         with self._lock:
@@ -1448,12 +1640,21 @@ class TcpTransport(Transport):
             raise PeerUnavailable(
                 f"cannot reach {peer_id}: connection refused (injected)")
         host, port = self._addr(peer_id)
+        via = self._via_relay.get(peer_id)
         try:
             sock = socket.create_connection((host, port),
                                             timeout=self.connect_timeout)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError as exc:
-            raise PeerUnavailable(f"cannot reach {peer_id} at {host}:{port}: {exc}")
+            err = PeerUnavailable(
+                f"cannot reach {peer_id} at {host}:{port}: {exc}")
+            if via:
+                # The socket we failed to open was the relay VOLUNTEER's:
+                # breaker blame goes to it, while routing blame (peer_id on
+                # the raised error) stays on the unreachable hop — one dead
+                # relay must not blacklist every peer behind it.
+                err.breaker_peer_id = via
+            raise err
         if plan is not None:
             sock = FaultSocket(sock, plan, side="client", peer=peer_id)
         with self._lock:
@@ -1472,6 +1673,30 @@ class TcpTransport(Transport):
                 sock.close()
             except OSError:
                 pass
+
+    def _unavailable(self, peer_id: str, exc: Exception) -> PeerUnavailable:
+        """Wrap a socket-level failure on `peer_id`'s connection. For a
+        relayed peer the socket belongs to the relay VOLUNTEER, so breaker
+        blame (breaker_peer_id) goes to the volunteer while routing blame
+        (the error's peer) stays on the hop — the relay-aware split the
+        client's recovery path keys on."""
+        err = PeerUnavailable(f"peer {peer_id} connection failed: {exc}")
+        via = self._via_relay.get(peer_id)
+        if via:
+            err.breaker_peer_id = via
+        return err
+
+    def _note_relay_failure(self, peer_id: str, request: StageRequest,
+                            error: Exception) -> None:
+        """Flight-recorder marker for a failed exchange with a peer reached
+        THROUGH a volunteer — doctor's failure chains key on this to tell a
+        relay loss from an ordinary peer death."""
+        via = self._via_relay.get(peer_id)
+        if via:
+            _ev.emit("relay_forward_error", session_id=request.session_id,
+                     trace_id=_trace_id(request), relay=via, peer=peer_id,
+                     verb="step" if self._streamable(request) else "forward",
+                     error=str(error)[:200])
 
     def alive(self, peer_id: str) -> bool:
         """Real liveness probe, not just registry presence: dial the peer and
@@ -1547,7 +1772,11 @@ class TcpTransport(Transport):
                 raise exc
         if self._streamable(request):
             return self._call_stream(peer_id, request, timeout)
-        sock = self._connect(peer_id)
+        try:
+            sock = self._connect(peer_id)
+        except PeerUnavailable as exc:
+            self._note_relay_failure(peer_id, request, exc)
+            raise
         if self.fault_plan is not None and isinstance(sock, FaultSocket):
             sock.ctx_verb = "train_forward" if request.train else "forward"
             sock.ctx_session = request.session_id
@@ -1584,7 +1813,7 @@ class TcpTransport(Transport):
                     wds += ["f32"] * len(lora_arrs)
                 metas, body = _encode_tensors(arrs, wds)
                 hdr["tensors"] = metas
-                _send_frame(sock, self._tagged(hdr), body)
+                self._send(peer_id, sock, self._tagged(hdr), body)
             elif request.prompts is not None:
                 # Deep-prompt inference step: prompts ride as a second
                 # payload tensor (classic frame — never streamed/pushed,
@@ -1596,7 +1825,7 @@ class TcpTransport(Transport):
                 hdr = _request_header(request, metas[0],
                                       prompts_meta=metas[1])
                 hdr["wire_dtype"] = self.wire_dtype
-                _send_frame(sock, self._tagged(hdr), body)
+                self._send(peer_id, sock, self._tagged(hdr), body)
             else:
                 arr = np.asarray(request.hidden)
                 meta, body = _encode_tensor(arr, self.wire_dtype)
@@ -1608,7 +1837,7 @@ class TcpTransport(Transport):
                 # an f32 client keeps exact activations from a
                 # bf16-default server.
                 hdr["wire_dtype"] = self.wire_dtype
-                _send_frame(sock, self._tagged(hdr), body)
+                self._send(peer_id, sock, self._tagged(hdr), body)
             self._m_sent.inc(len(body))
             header, payload = _recv_frame(sock)
             self._m_recv.inc(len(payload))
@@ -1619,10 +1848,11 @@ class TcpTransport(Transport):
             raise TimeoutError(f"peer {peer_id} timed out") from exc
         except (ConnectionError, OSError) as exc:
             self._drop(peer_id)
+            self._note_relay_failure(peer_id, request, exc)
             _ev.emit("transport_error", session_id=request.session_id,
                      trace_id=_trace_id(request), peer=peer_id,
                      error=str(exc)[:200])
-            raise PeerUnavailable(f"peer {peer_id} connection failed: {exc}")
+            raise self._unavailable(peer_id, exc)
         return self._parse_response(peer_id, header, payload)
 
     def _call_stream(self, peer_id: str, request: StageRequest,
@@ -1640,7 +1870,11 @@ class TcpTransport(Transport):
                 request.max_length, request.start_block, request.end_block,
                 tuple(json.dumps(n, sort_keys=True)
                       for n in request.next_servers))
-        sock = self._connect(peer_id)
+        try:
+            sock = self._connect(peer_id)
+        except PeerUnavailable as exc:
+            self._note_relay_failure(peer_id, request, exc)
+            raise
         if self.fault_plan is not None and isinstance(sock, FaultSocket):
             sock.ctx_verb = "step"
             sock.ctx_session = request.session_id
@@ -1666,7 +1900,7 @@ class TcpTransport(Transport):
                     "deadline_s": self.session_deadline_s,
                     "wire_dtype": self.wire_dtype,
                 }
-                _send_frame(sock, self._tagged(open_hdr))
+                self._send(peer_id, sock, self._tagged(open_hdr))
                 h, _ = _recv_frame(sock)
                 if h.get("verb") != "ok":
                     self._parse_response(peer_id, h, b"")  # raises
@@ -1709,7 +1943,7 @@ class TcpTransport(Transport):
             meta, body = _encode_tensor(arr, self.wire_dtype)
             hdr["tensor"] = meta
             self._m_calls.labels(verb="step").inc()
-            _send_frame(sock, hdr, body)
+            self._send(peer_id, sock, hdr, body)
             self._m_sent.inc(len(body))
             header, payload = _recv_frame(sock)
             self._m_recv.inc(len(payload))
@@ -1720,10 +1954,11 @@ class TcpTransport(Transport):
             raise TimeoutError(f"peer {peer_id} timed out") from exc
         except (ConnectionError, OSError) as exc:
             self._drop(peer_id)
+            self._note_relay_failure(peer_id, request, exc)
             _ev.emit("transport_error", session_id=request.session_id,
                      trace_id=_trace_id(request), peer=peer_id,
                      error=str(exc)[:200])
-            raise PeerUnavailable(f"peer {peer_id} connection failed: {exc}")
+            raise self._unavailable(peer_id, exc)
         try:
             resp = self._parse_response(peer_id, header, payload)
         except StageExecutionError:
@@ -1809,8 +2044,14 @@ class TcpTransport(Transport):
                     header.get("message", f"peer {peer_id}: task rejected"),
                     permanent=True)
             if header.get("kind") == "push":
-                raise PushChainError(header.get("peer", "?"),
+                exc = PushChainError(header.get("peer", "?"),
                                      header.get("message", "push failed"))
+                # Relay-aware blame split: `peer` is the hop to route
+                # around; `breaker_peer` (present only when they differ —
+                # e.g. a relay volunteer died, not the peer behind it) is
+                # the component whose circuit breaker should open.
+                exc.breaker_peer_id = header.get("breaker_peer")
+                raise exc
             if header.get("kind") == "stage":
                 exc = StageExecutionError(header.get("message", "stage error"))
                 # Chain mode: the error may originate from a downstream hop.
@@ -1849,7 +2090,7 @@ class TcpTransport(Transport):
                 arrs += [np.asarray(a) for a in lora_arrs]
             metas, body = _encode_tensors(arrs, "f32")
             hdr["tensors"] = metas
-            _send_frame(sock, self._tagged(hdr), body)
+            self._send(peer_id, sock, self._tagged(hdr), body)
             header, payload = _recv_frame(sock)
         except socket.timeout as exc:
             self._drop(peer_id)
@@ -1886,7 +2127,8 @@ class TcpTransport(Transport):
         try:
             sock = self._connect(peer_id)
             sock.settimeout(self.connect_timeout)
-            _send_frame(sock, {"verb": "end_session", "session_id": session_id})
+            self._send(peer_id, sock,
+                       {"verb": "end_session", "session_id": session_id})
             _recv_frame(sock)
         except (PeerUnavailable, TimeoutError, ConnectionError, OSError):
             self._drop(peer_id)
@@ -1895,7 +2137,7 @@ class TcpTransport(Transport):
         sock = self._connect(peer_id)
         try:
             sock.settimeout(timeout)
-            _send_frame(sock, {"verb": "info"})
+            self._send(peer_id, sock, {"verb": "info"})
             header, _ = _recv_frame(sock)
             return header
         except (ConnectionError, OSError) as exc:
@@ -1908,7 +2150,7 @@ class TcpTransport(Transport):
         sock = self._connect(peer_id)
         try:
             sock.settimeout(timeout)
-            _send_frame(sock, {"verb": "metrics"})
+            self._send(peer_id, sock, {"verb": "metrics"})
             header, _ = _recv_frame(sock)
         except (ConnectionError, OSError) as exc:
             self._drop(peer_id)
@@ -1925,7 +2167,7 @@ class TcpTransport(Transport):
         sock = self._connect(peer_id)
         try:
             sock.settimeout(timeout)
-            _send_frame(sock, {"verb": "dump-events"})
+            self._send(peer_id, sock, {"verb": "dump-events"})
             header, _ = _recv_frame(sock)
         except (ConnectionError, OSError) as exc:
             self._drop(peer_id)
@@ -1942,7 +2184,7 @@ class TcpTransport(Transport):
         sock = self._connect(peer_id)
         try:
             sock.settimeout(timeout)
-            _send_frame(sock, {"verb": "swarm-stats"})
+            self._send(peer_id, sock, {"verb": "swarm-stats"})
             header, _ = _recv_frame(sock)
         except (ConnectionError, OSError) as exc:
             self._drop(peer_id)
@@ -1967,7 +2209,7 @@ class TcpTransport(Transport):
         sock = self._connect(peer_id)
         try:
             sock.settimeout(timeout)
-            _send_frame(sock, header)
+            self._send(peer_id, sock, header)
             h, _ = _recv_frame(sock)
         except (ConnectionError, OSError) as exc:
             self._drop(peer_id)
@@ -2002,12 +2244,37 @@ class TcpTransport(Transport):
         sock = self._connect(peer_id)
         try:
             sock.settimeout(timeout)
-            _send_frame(sock, {"verb": "reach_check", "target": target})
+            self._send(peer_id, sock,
+                       {"verb": "reach_check", "target": target})
             header, _ = _recv_frame(sock)
             return bool(header.get("ok"))
         except (ConnectionError, OSError) as exc:
             self._drop(peer_id)
             raise PeerUnavailable(f"peer {peer_id}: {exc}")
+
+    def relay_attach(self, peer_id: str, my_peer_id: str, my_address: str,
+                     timeout: float = 5.0) -> dict:
+        """Ask volunteer `peer_id` to forward for us: open (or refresh — the
+        verb is an idempotent lease renewal) a relay circuit mapping
+        `my_peer_id` -> `my_address`. The address must be one the VOLUNTEER
+        can dial (our bind address, inside the NAT) — by definition not the
+        advertised one that failed the reachability vote. Raises
+        PeerUnavailable when the volunteer sheds (saturated) or is gone, so
+        the picker moves on to the next candidate."""
+        sock = self._connect(peer_id)
+        try:
+            sock.settimeout(timeout)
+            self._send(peer_id, sock, {"verb": "relay_attach",
+                                       "peer_id": my_peer_id,
+                                       "address": my_address})
+            header, _ = _recv_frame(sock)
+        except (ConnectionError, OSError) as exc:
+            self._drop(peer_id)
+            raise PeerUnavailable(f"peer {peer_id}: {exc}")
+        if header.get("verb") != "ok":
+            raise PeerUnavailable(
+                f"relay {peer_id} refused attach: {header.get('message')}")
+        return header
 
     def close(self) -> None:
         with self._lock:
@@ -2042,6 +2309,34 @@ def check_direct_reachability(transport: TcpTransport, registry,
     if not votes:
         return None
     return sum(votes) / len(votes) >= threshold
+
+
+def attach_via_relay(transport: TcpTransport, registry, my_peer_id: str,
+                     my_address: str, exclude=()) -> Optional[dict]:
+    """Pick a relay volunteer and attach to it (petals' relay fallback after
+    a failed reachability vote). Candidates are live peers that advertise
+    relay capacity and are not themselves relayed — relaying through a
+    relayed peer would chain circuits. Tried most-spare-capacity first; a
+    saturated volunteer sheds with an error frame and the next candidate is
+    tried, so load spreads by construction. Returns the volunteer's ok frame
+    with ``"relay"`` = its peer_id, or None when nobody volunteers (the
+    caller stays unregistered and retries on its heartbeat cadence)."""
+    skip = set(exclude) | {my_peer_id}
+    cands = [r for r in registry.live_servers()
+             if r.peer_id not in skip
+             and getattr(r, "address", None)
+             and (getattr(r, "relay_capacity", None) or 0) > 0
+             and not getattr(r, "relay_via", None)]
+    cands.sort(key=lambda r: -(r.relay_capacity or 0))
+    for rec in cands:
+        try:
+            ok = transport.relay_attach(rec.peer_id, my_peer_id, my_address)
+        except (PeerUnavailable, TimeoutError, ConnectionError, OSError,
+                WireError):
+            continue
+        ok["relay"] = rec.peer_id
+        return ok
+    return None
 
 
 # ---------------------------------------------------------------------------

@@ -81,6 +81,17 @@ class ServerRecord:
     # heartbeat — the _ping_next_servers signal (petals/server/server.py:760-767)
     # consumed by scheduling.routing's latency-aware planner.
     next_server_rtts: Optional[Dict[str, float]] = None
+    # NAT relay data plane (petals/server/reachability.py): a server that
+    # fails the dial-back vote attaches to a reachable volunteer and sets
+    # relay_via to that volunteer's peer_id. Its `address` stays its OWN
+    # advertised (unreachable) address; clients resolve relay_via -> the
+    # volunteer's record and dial the volunteer instead, stamping frames
+    # with relay_to so the volunteer forwards verbatim.
+    relay_via: Optional[str] = None
+    # Volunteer capability: how many relayed peers this server is willing to
+    # forward for (0/None = does not volunteer). Attach requests beyond this
+    # are shed with an error frame so load spreads across volunteers.
+    relay_capacity: Optional[int] = None
     timestamp: float = dataclasses.field(default_factory=time.monotonic)
     expires_at: float = 0.0
 
@@ -97,7 +108,8 @@ class ServerRecord:
 # age/TTL-remaining and is re-anchored on receipt.
 REC_FIELDS = ("peer_id", "start_block", "end_block", "throughput", "state",
               "final_stage", "stage_index", "cache_tokens_left", "address",
-              "next_server_rtts", "model", "engine", "max_context")
+              "next_server_rtts", "model", "engine", "max_context",
+              "relay_via", "relay_capacity")
 
 
 def rec_to_dict(rec: "ServerRecord") -> dict:

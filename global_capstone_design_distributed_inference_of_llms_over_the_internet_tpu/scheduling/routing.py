@@ -69,7 +69,16 @@ def hop_rtt(prev_peer: str, record: ServerRecord,
         prev = records_by_id.get(prev_peer)
         rtts = getattr(prev, "next_server_rtts", None) if prev else None
         rtt = rtts.get(record.peer_id) if rtts else None
-    return default_rtt if rtt is None else rtt
+    base = default_rtt if rtt is None else rtt
+    # A relayed peer is reached via its volunteer — traffic pays the sender→
+    # relay leg (the base above: measured or default) PLUS the relay→peer
+    # forwarding leg, which nobody measures. Charge the extra leg at
+    # default_rtt so relayed peers lose ties against direct-reachable
+    # equivalents (the reference's relay deprioritization, on top of the
+    # RELAY_PENALTY already folded into the advertised throughput).
+    if getattr(record, "relay_via", None):
+        base += default_rtt
+    return base
 
 
 def plan_min_latency_route(

@@ -154,6 +154,14 @@ SPEC: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Sequence[float]]]] = {
     "transport_faults_injected_total": (
         COUNTER, "Chaos-layer fault firings, per kind (runtime.faults).",
         ("kind",), None),
+    # -- NAT relay data plane ------------------------------------------------
+    "relay_forwarded_total": (
+        COUNTER, "Frames this volunteer forwarded on behalf of relayed "
+                 "(NAT'd) peers, per outcome (ok|error|drop|no_circuit).",
+        ("outcome",), None),
+    "relay_active_circuits": (
+        GAUGE, "Relay circuits (attached NAT'd peers with an unexpired "
+               "lease) this volunteer currently serves.", (), None),
     # -- gossip control plane -----------------------------------------------
     "gossip_rounds_total": (
         COUNTER, "Anti-entropy exchanges, per role (initiator|responder).",

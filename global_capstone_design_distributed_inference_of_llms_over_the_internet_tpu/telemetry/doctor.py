@@ -38,6 +38,7 @@ _TRIGGERS = {
     "deadline_rejected": "deadline rejected",
     "registry_unreachable": "registries unreachable",
     "request_shed": "request shed",
+    "relay_forward_error": "relay lost",
 }
 # Events that CONTINUE a chain once triggered.
 _CHAIN = {
@@ -52,6 +53,9 @@ _CHAIN = {
     # Gateway fairness story: what got in and finished around a shed —
     # a shed request's chain shows whether admission was load or a bug.
     "request_admitted", "request_completed",
+    # Relay loss story: the circuit break (a trigger) is followed by the
+    # NAT'd peer re-attaching via a new volunteer.
+    "relay_attach",
 }
 
 # Counter patterns in the embedded Prometheus exposition that should be
@@ -145,6 +149,12 @@ def _describe(ev: dict) -> str:
     if name == "deadline_rejected":
         return (f"{f.get('peer', '?')} rejected expired deadline "
                 f"(budget {f.get('budget_s', '?')}s)")
+    if name == "relay_forward_error":
+        return (f"relay {f.get('relay', '?')} lost for "
+                f"{f.get('peer', '?')} ({str(f.get('error', ''))[:60]})")
+    if name == "relay_attach":
+        return (f"{f.get('peer', '?')} attached via relay "
+                f"{f.get('relay', '?')}")
     if name == "registry_unreachable":
         return f"all {f.get('registries', '?')} registries unreachable"
     if name == "registry_stale_serve":

@@ -134,6 +134,17 @@ EVENTS: Dict[str, Tuple[str, str, str]] = {
         "transport", WARN,
         "The chaos layer fired a scheduled fault (fields: kind, site, "
         "peer, verb; runtime.faults.FaultPlan)."),
+    # -- NAT relay data plane ------------------------------------------------
+    "relay_attach": (
+        "relay", INFO,
+        "An unreachable server attached to (or re-selected) a relay "
+        "volunteer after failing the dial-back vote (fields: peer, relay, "
+        "address)."),
+    "relay_forward_error": (
+        "relay", ERROR,
+        "A relay circuit failed: the volunteer could not forward to its "
+        "relayed peer, or (client-side) an exchange through a volunteer "
+        "died (fields: relay, peer, verb, error)."),
     # -- circuit breaker / deadline budgets ----------------------------------
     "breaker_open": (
         "client", WARN,

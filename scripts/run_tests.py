@@ -64,7 +64,7 @@ SHARDS = [
     # 4: protocol extensions
     ["test_push_chain.py", "test_nf4_kernel.py", "test_prefix_cache.py",
      "test_quant.py", "test_quant_coverage.py", "test_quarantine_hook.py",
-     "test_remote_store.py", "test_ring_attention.py",
+     "test_relay.py", "test_remote_store.py", "test_ring_attention.py",
      "test_ring_decode.py", "test_routing_rtt.py"],
     # 5: pipeline runtime + serving engines
     ["test_runtime_pipeline.py", "test_serve_batched.py",
