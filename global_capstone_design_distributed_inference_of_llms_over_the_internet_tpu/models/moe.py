@@ -59,14 +59,22 @@ def moe_sparse_enabled() -> bool:
     """MOE_SPARSE=1 (default ON) routes MoE layers through the sparse
     sort-and-dispatch path above. MOE_SPARSE=0 restores the dense
     all-expert einsums bit-for-bit (the tiny-model fallback and kill
-    switch, same idiom as INT8_FOLD/NF4_KERNEL)."""
-    return os.environ.get("MOE_SPARSE", "1") == "1"
+    switch, same idiom as INT8_FOLD/NF4_KERNEL).
+
+    Trace-time flag (utils/flags.py catalog): resolved while the engine
+    traces, so flips after warmup require a retrace."""
+    from ..utils.flags import bool_flag
+
+    return bool_flag("MOE_SPARSE")
 
 
 def moe_capacity_factor() -> float:
     """Per-expert slot budget multiplier over the perfectly-balanced load
-    (``MOE_CAPACITY_FACTOR``, default 2.0; <= 0 means drop-free)."""
-    return float(os.environ.get("MOE_CAPACITY_FACTOR", "2.0"))
+    (``MOE_CAPACITY_FACTOR``, default 2.0; <= 0 means drop-free).
+    Trace-time flag: baked into the dispatch shapes at trace time."""
+    from ..utils.flags import float_flag
+
+    return float_flag("MOE_CAPACITY_FACTOR")
 
 
 def moe_capacity(n_tokens: int, num_experts: int, top_k: int) -> int:

@@ -244,10 +244,13 @@ _QUANT_TYPES = (QuantizedTensor, NF4Tensor)
 def nf4_kernel_enabled() -> bool:
     """NF4_KERNEL=1 routes per-layer NF4 matmuls through the fused Pallas
     dequant-matmul kernel (ops.nf4_kernel) instead of materializing the
-    weight — the measured lever for nf4 decode throughput. Default OFF."""
-    import os
+    weight — the measured lever for nf4 decode throughput. Default OFF.
 
-    return os.environ.get("NF4_KERNEL", "0") == "1"
+    Trace-time flag (utils/flags.py catalog): resolved while the engine
+    traces, so flips after warmup require a retrace."""
+    from ..utils.flags import bool_flag
+
+    return bool_flag("NF4_KERNEL")
 
 
 def int8_fold_enabled() -> bool:
@@ -257,10 +260,13 @@ def int8_fold_enabled() -> bool:
     instead of materializing a full bf16 weight per layer first — the
     difference between 0.65 and roofline `frac_of_sustained` on decode.
     INT8_FOLD=0 restores the dequant-materialize path (bit-for-bit the
-    round-5 behavior) as the kill switch."""
-    import os
+    round-5 behavior) as the kill switch.
 
-    return os.environ.get("INT8_FOLD", "1") == "1"
+    Trace-time flag (utils/flags.py catalog): resolved while the engine
+    traces, so flips after warmup require a retrace."""
+    from ..utils.flags import bool_flag
+
+    return bool_flag("INT8_FOLD")
 
 
 def dequant_tree(tree: Params, keep_experts: bool = False) -> Params:

@@ -172,11 +172,16 @@ class KVArena:
 
     @property
     def used_bytes(self) -> int:
-        return self._used_bytes
+        with self._lock:
+            return self._used_bytes
 
     @property
     def bytes_left(self) -> int:
-        return self.max_bytes - self._used_bytes - self._enqueued_bytes
+        # Both counters under the lock: read apart they can double-count a
+        # waiter mid-admission and advertise negative capacity. (The
+        # Condition's default RLock keeps this reentrancy-safe.)
+        with self._lock:
+            return self.max_bytes - self._used_bytes - self._enqueued_bytes
 
     def tokens_left(self) -> int:
         """Advertised capacity (the DHT's ``cache_tokens_left``,

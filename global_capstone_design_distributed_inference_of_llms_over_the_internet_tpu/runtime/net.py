@@ -1640,7 +1640,8 @@ class TcpTransport(Transport):
             raise PeerUnavailable(
                 f"cannot reach {peer_id}: connection refused (injected)")
         host, port = self._addr(peer_id)
-        via = self._via_relay.get(peer_id)
+        with self._lock:
+            via = self._via_relay.get(peer_id)
         try:
             sock = socket.create_connection((host, port),
                                             timeout=self.connect_timeout)
@@ -1681,7 +1682,8 @@ class TcpTransport(Transport):
         (the error's peer) stays on the hop — the relay-aware split the
         client's recovery path keys on."""
         err = PeerUnavailable(f"peer {peer_id} connection failed: {exc}")
-        via = self._via_relay.get(peer_id)
+        with self._lock:
+            via = self._via_relay.get(peer_id)
         if via:
             err.breaker_peer_id = via
         return err
@@ -1691,7 +1693,8 @@ class TcpTransport(Transport):
         """Flight-recorder marker for a failed exchange with a peer reached
         THROUGH a volunteer — doctor's failure chains key on this to tell a
         relay loss from an ordinary peer death."""
-        via = self._via_relay.get(peer_id)
+        with self._lock:
+            via = self._via_relay.get(peer_id)
         if via:
             _ev.emit("relay_forward_error", session_id=request.session_id,
                      trace_id=_trace_id(request), relay=via, peer=peer_id,

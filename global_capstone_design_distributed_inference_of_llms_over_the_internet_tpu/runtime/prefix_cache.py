@@ -121,7 +121,8 @@ class PrefixStore:
         self._m_bytes = _tm.get("server_prefix_cache_used_bytes")
 
     def __len__(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     def lookup_chain(self, keys: List[str],
                      need_out: bool) -> List[PrefixEntry]:

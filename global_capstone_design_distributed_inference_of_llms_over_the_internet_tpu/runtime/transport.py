@@ -153,7 +153,8 @@ class LocalTransport(Transport):
             self._dead.pop(peer_id, None)
 
     def executor(self, peer_id: str) -> StageExecutor:
-        return self._peers[peer_id]
+        with self._lock:
+            return self._peers[peer_id]
 
     def peers(self):
         with self._lock:

@@ -371,11 +371,13 @@ class EventRecorder:
         an optional `_spans` record embedding the tracer's recent span
         buffer (the doctor's critical-path input), then one event per
         line, oldest first."""
+        with self._lock:
+            dropped = self.dropped
         lines = [json.dumps({
             "record": "_meta", "pid": os.getpid(),
             "argv": list(sys.argv), "wall": time.time(),
             "mono": time.monotonic(), "capacity": self.capacity,
-            "dropped": self.dropped,
+            "dropped": dropped,
         }, sort_keys=True)]
         if registry is None:
             from .metrics import get_registry

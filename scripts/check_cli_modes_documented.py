@@ -1,65 +1,20 @@
 #!/usr/bin/env python
-"""Fail (exit 1) when the CLI's ``--mode`` surface and the docs drift.
+"""Thin shim over the graftlint driver (analyzer: ``cli_doc``).
 
-Both directions:
-
-  * every choice in main.py's ``--mode`` (and ``--chaos_scenario``) argparse
-    declaration must be shown in use — as ``--mode <choice>`` /
-    ``--chaos_scenario <choice>`` — somewhere in README.md or docs/*.md,
-    so no entry point ships undocumented;
-  * every ``--mode <word>`` / ``--chaos_scenario <word>`` usage in those
-    files must name a real choice, so renamed or removed modes cannot
-    linger in the docs.
-
-The parser choices are read from main.py's SOURCE TEXT (regex, no import):
-main.py pulls in jax at import time and this check must stay cheap enough
-to run as a tier-1 test (tests/test_cli_modes_documented.py).
+The check itself lives in scripts/graftlint/legacy.py — one driver, one
+finding format, one baseline. This entry point survives so existing
+tier-1 wrappers (tests/test_cli_modes_documented.py) keep working; it
+exits non-zero when main.py's ``--mode``/``--chaos_scenario`` choices and
+the docs drift in either direction.
 """
 
 import pathlib
-import re
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
-MAIN = (REPO / "global_capstone_design_distributed_inference_of_llms"
-        "_over_the_internet_tpu" / "main.py")
-DOCS = [REPO / "README.md"] + sorted((REPO / "docs").glob("*.md"))
+sys.path.insert(0, str(REPO))
 
-
-def parser_choices(src: str, flag: str) -> list:
-    m = re.search(
-        r'add_argument\(\s*"%s",\s*choices=\[(.*?)\]' % re.escape(flag),
-        src, re.S)
-    if not m:
-        print(f"could not find {flag} choices in {MAIN.relative_to(REPO)}")
-        sys.exit(2)
-    return re.findall(r'"([a-z0-9_-]+)"', m.group(1))
-
-
-def main() -> int:
-    src = MAIN.read_text(encoding="utf-8")
-    text = "\n".join(p.read_text(encoding="utf-8") for p in DOCS if p.exists())
-    failed = False
-    for flag, choices in (("--mode", parser_choices(src, "--mode")),
-                          ("--chaos_scenario",
-                           parser_choices(src, "--chaos_scenario"))):
-        used = set(re.findall(r"%s[ =]+([a-z0-9_-]+)" % re.escape(flag), text))
-        undocumented = [c for c in choices if c not in used]
-        unknown = sorted(used - set(choices))
-        if undocumented:
-            failed = True
-            print(f"{flag} choices never shown in README.md or docs/*.md:")
-            for c in undocumented:
-                print(f"  {c}")
-        if unknown:
-            failed = True
-            print(f"{flag} usages in the docs that are not parser choices:")
-            for c in unknown:
-                print(f"  {c}")
-        if not undocumented and not unknown:
-            print(f"ok: all {len(choices)} {flag} choices documented")
-    return 1 if failed else 0
-
+from scripts.graftlint.__main__ import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--analyzer", "cli_doc"]))

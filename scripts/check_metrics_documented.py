@@ -1,113 +1,20 @@
 #!/usr/bin/env python
-"""Fail (exit 1) when telemetry catalogs and docs/OBSERVABILITY.md drift.
+"""Thin shim over the graftlint driver (analyzer: ``metrics_doc``).
 
-Covers BOTH catalogs, in both directions:
-
-  * every metric in ``telemetry.catalog.SPEC`` must appear (backticked) in
-    docs/OBSERVABILITY.md — new instrumentation cannot ship undocumented;
-  * every backticked ``server_*``/``client_*``/``transport_*``/
-    ``scheduler_*`` metric-shaped name in the doc must exist in the catalog
-    — stale docs cannot describe metrics that no longer exist;
-  * every flight-recorder event in ``telemetry.events.EVENTS`` must appear
-    (backticked) in the doc's "Event log & doctor" section, and every
-    backticked token in that section's event table must be a real event.
-
-Pure stdlib + the dependency-free telemetry package (no jax import), so the
-check is fast enough to run as a tier-1 test
-(tests/test_metrics_documented.py).
+The check itself lives in scripts/graftlint/legacy.py — one driver, one
+finding format, one baseline. This entry point survives so existing
+tier-1 wrappers (tests/test_metrics_documented.py) keep working; it exits
+non-zero when telemetry catalogs (metrics, events, profiler phases,
+digest fields) and docs/OBSERVABILITY.md drift in either direction.
 """
 
 import pathlib
-import re
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
 
-from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.telemetry.catalog import (  # noqa: E402
-    SPEC,
-    all_names,
-)
-from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.telemetry.events import (  # noqa: E402
-    EVENTS,
-    all_event_names,
-)
-from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.telemetry.profiling import (  # noqa: E402
-    DIGEST_FIELDS,
-    PHASES,
-)
-
-DOC = REPO / "docs" / "OBSERVABILITY.md"
-
-# Backticked tokens that look like catalog metrics. The suffix alternation
-# keeps prose like `server_forward` (a span name) out of scope.
-_DOC_METRIC_RE = re.compile(
-    r"`((?:server|client|transport|scheduler|gateway)_[a-z0-9_]+"
-    r"(?:_total|_seconds|_bytes|_ratio|_sessions|_hops|_depth|_rate))`"
-)
-
-# Event names in the doc's event table: backticked first-column cells.
-# Scoped to table rows (leading pipe) so prose backticks like `--mode
-# doctor` or field names stay out of scope.
-_DOC_EVENT_RE = re.compile(r"^\|\s*`([a-z][a-z0-9_]+)`", re.MULTILINE)
-
-
-def main() -> int:
-    if not DOC.exists():
-        print(f"missing {DOC.relative_to(REPO)}")
-        return 1
-    text = DOC.read_text(encoding="utf-8")
-
-    undocumented = [n for n in all_names() if f"`{n}`" not in text]
-    unknown = sorted(
-        {m for m in _DOC_METRIC_RE.findall(text) if m not in SPEC}
-    )
-    ev_undocumented = [n for n in all_event_names()
-                       if f"`{n}`" not in text]
-    ev_unknown = sorted(
-        {m for m in _DOC_EVENT_RE.findall(text)
-         if m not in EVENTS and m not in SPEC
-         and m not in PHASES and m not in DIGEST_FIELDS}
-    )
-    # The profiler's phase names and the gossiped stats-digest fields are
-    # operator surface too (--profile_phases histograms, --mode top
-    # columns): each must appear backticked in the doc.
-    prof_undocumented = [n for n in (*PHASES, *DIGEST_FIELDS)
-                         if f"`{n}`" not in text]
-
-    if undocumented:
-        print("metrics in telemetry/catalog.py missing from "
-              "docs/OBSERVABILITY.md:")
-        for n in undocumented:
-            print(f"  {n}")
-    if unknown:
-        print("metric names documented in docs/OBSERVABILITY.md but absent "
-              "from telemetry/catalog.py:")
-        for n in unknown:
-            print(f"  {n}")
-    if ev_undocumented:
-        print("events in telemetry/events.py missing from "
-              "docs/OBSERVABILITY.md:")
-        for n in ev_undocumented:
-            print(f"  {n}")
-    if ev_unknown:
-        print("event names documented in docs/OBSERVABILITY.md but absent "
-              "from telemetry/events.py:")
-        for n in ev_unknown:
-            print(f"  {n}")
-    if prof_undocumented:
-        print("profiler phases / stats-digest fields (telemetry/"
-              "profiling.py) missing from docs/OBSERVABILITY.md:")
-        for n in prof_undocumented:
-            print(f"  {n}")
-    if (undocumented or unknown or ev_undocumented or ev_unknown
-            or prof_undocumented):
-        return 1
-    print(f"ok: {len(all_names())} metrics, {len(all_event_names())} "
-          f"events, {len(PHASES)} phases, and {len(DIGEST_FIELDS)} digest "
-          "fields documented")
-    return 0
-
+from scripts.graftlint.__main__ import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--analyzer", "metrics_doc"]))

@@ -1,71 +1,20 @@
 #!/usr/bin/env python
-"""Fail (exit 1) on bare ``print(`` calls in the package's library code.
+"""Thin shim over the graftlint driver (analyzer: ``bare_print``).
 
-Library modules (runtime/, scheduling/, telemetry/, models/, parallel/,
-ops/, utils/) must route diagnostics through ``logging`` — a server
-embedded in another process must not write to the host's stdout. The CLI
-(``main.py``) is the one module that legitimately produces stdout, and
-there every line goes through its ``_emit()`` helper so the output
-boundary is a single grep-able function.
-
-AST-based, not regex: comments, docstrings, and strings mentioning
-print() don't trip it. Pure stdlib (no jax import) so the check runs as a
-tier-1 test (tests/test_no_bare_print.py).
+The check itself lives in scripts/graftlint/legacy.py — one driver, one
+finding format, one baseline. This entry point survives so existing
+tier-1 wrappers (tests/test_no_bare_print.py) and muscle memory keep
+working; it exits non-zero on any non-baselined bare ``print()`` in the
+package's library code.
 """
 
-import ast
 import pathlib
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
-PKG = REPO / "global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu"
+sys.path.insert(0, str(REPO))
 
-# main.py: print() is allowed ONLY inside the _emit() wrapper.
-CLI_ALLOWED_FUNC = "_emit"
-
-
-def _bare_prints(tree: ast.AST, *, allow_in: str = None) -> list:
-    """(lineno, context) of every print() call, skipping calls lexically
-    inside a function named `allow_in`."""
-    hits = []
-
-    def walk(node, inside_allowed):
-        for child in ast.iter_child_nodes(node):
-            allowed = inside_allowed
-            if (isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
-                    and child.name == allow_in):
-                allowed = True
-            if (isinstance(child, ast.Call)
-                    and isinstance(child.func, ast.Name)
-                    and child.func.id == "print"
-                    and not allowed):
-                hits.append(child.lineno)
-            walk(child, allowed)
-
-    walk(tree, False)
-    return hits
-
-
-def main() -> int:
-    bad = []
-    for path in sorted(PKG.rglob("*.py")):
-        rel = path.relative_to(REPO)
-        allow = CLI_ALLOWED_FUNC if path.name == "main.py" else None
-        try:
-            tree = ast.parse(path.read_text(encoding="utf-8"))
-        except SyntaxError as exc:
-            print(f"{rel}: syntax error: {exc}")
-            return 1
-        for lineno in _bare_prints(tree, allow_in=allow):
-            bad.append(f"{rel}:{lineno}")
-    if bad:
-        print("bare print() calls (use a logger, or _emit() in main.py):")
-        for b in bad:
-            print(f"  {b}")
-        return 1
-    print("ok: no bare print() calls in library code")
-    return 0
-
+from scripts.graftlint.__main__ import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--analyzer", "bare_print"]))

@@ -1,102 +1,20 @@
 #!/usr/bin/env python
-"""Fail (exit 1) when a quant format ships without bench + parity coverage.
+"""Thin shim over the graftlint driver (analyzer: ``quant_coverage``).
 
-Every format listed in ``models/quant.py::QUANT_BITS`` (except "none",
-the unquantized baseline every row already is) must have:
-
-  * a bench row: a ``quantize_params(..., "<fmt>")`` call (or the
-    ``_qp(..., "<fmt>")`` alias) inside bench.py, so regressions in the
-    format's serving path surface in ``BENCH_*`` numbers;
-  * a parity test: a ``"<fmt>"`` quantize under tests/ whose module
-    asserts token equality against a dequantized/materialized reference
-    (grepped as a quantize call in a tests/test_*.py file that also
-    contains a parity-style assertion);
-  * an MoE-path parity test: the same, in a module that exercises the
-    MoE layer stack (mentions mixtral/moe) — the sparse dispatch keeps
-    expert stacks PACKED (models/moe.py ``_expert_dot``), a separate code
-    path from the 2-D per-layer dequant the dense tests pin, so a format
-    can regress there while every dense parity test stays green.
-
-The format list is read from quant.py's SOURCE TEXT (regex, no import):
-quant.py pulls in jax at import time and this check must stay cheap
-enough to run as a tier-1 test (tests/test_quant_coverage.py).
+The check itself lives in scripts/graftlint/legacy.py — one driver, one
+finding format, one baseline. This entry point survives so existing
+tier-1 wrappers (tests/test_quant_coverage.py) keep working; it exits
+non-zero when a quant format in models/quant.py::QUANT_BITS lacks a bench
+row, a parity test, or an MoE-path parity test.
 """
 
 import pathlib
-import re
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
-QUANT = (REPO / "global_capstone_design_distributed_inference_of_llms"
-         "_over_the_internet_tpu" / "models" / "quant.py")
-BENCH = REPO / "bench.py"
-TESTS = sorted((REPO / "tests").glob("test_*.py"))
+sys.path.insert(0, str(REPO))
 
-
-def quant_formats(src: str) -> list:
-    m = re.search(r"QUANT_BITS\s*=\s*\{(.*?)\}", src, re.S)
-    if not m:
-        print(f"could not find QUANT_BITS in {QUANT.relative_to(REPO)}")
-        sys.exit(2)
-    fmts = re.findall(r'"([a-z0-9_]+)"\s*:', m.group(1))
-    return [f for f in fmts if f != "none"]
-
-
-_CALL = r"(?:quantize_params|quantize_layers|_qp|_sqp)"
-# Call args with one level of paren nesting allowed before the mode string
-# (e.g. quantize_params(slice_stage_params(cfg, params, spec), "nf4")).
-_ARGS = r"\((?:[^()]|\([^()]*\))*?"
-
-
-def _quantize_calls(text: str, fmts) -> set:
-    # quantize_params(x, "fmt") / quantize_layers(x, "fmt") and the local
-    # aliases bench.py uses (_qp/_sqp). Mode omitted means int8 (the
-    # signature default).
-    called = {f for f in fmts
-              if re.search(_CALL + _ARGS + '"%s"' % re.escape(f), text)}
-    if re.search(_CALL + r'\(\s*[a-zA-Z_][^,")]*\)', text):
-        called.add("int8")
-    return called
-
-
-def main() -> int:
-    fmts = quant_formats(QUANT.read_text(encoding="utf-8"))
-    bench_cov = _quantize_calls(BENCH.read_text(encoding="utf-8"), fmts)
-    parity_cov = set()
-    moe_cov = set()
-    for p in TESTS:
-        text = p.read_text(encoding="utf-8")
-        # A parity module compares quantized serving against a dequantized
-        # or materialized reference by exact equality.
-        if not re.search(r"dequant|materializ", text):
-            continue
-        if not re.search(r"assert .*==|assert_array_equal", text):
-            continue
-        covered = _quantize_calls(text, fmts)
-        parity_cov |= covered
-        # The MoE-path requirement: the parity module must run the expert
-        # stack (mixtral config / moe module), not just dense layers.
-        if re.search(r"mixtral|moe", text, re.I):
-            moe_cov |= covered
-    failed = False
-    for fmt in fmts:
-        missing = []
-        if fmt not in bench_cov:
-            missing.append("bench row in bench.py")
-        if fmt not in parity_cov:
-            missing.append("parity test under tests/")
-        if fmt not in moe_cov:
-            missing.append("MoE-path parity test under tests/ "
-                           "(mixtral/moe module)")
-        if missing:
-            failed = True
-            print(f"quant format {fmt!r} (models/quant.py QUANT_BITS) "
-                  f"lacks: {', '.join(missing)}")
-    if not failed:
-        print(f"ok: all {len(fmts)} quant formats have bench rows, parity "
-              f"tests, and MoE-path parity tests")
-    return 1 if failed else 0
-
+from scripts.graftlint.__main__ import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--analyzer", "quant_coverage"]))

@@ -1,0 +1,37 @@
+"""graftlint: repo-native static analysis for the swarm codebase.
+
+Three analyzer families over the package's ASTs, unified under one driver
+and one finding format (docs/STATIC_ANALYSIS.md):
+
+  * **Lock discipline** (`locks.py`): per class, infer the attributes the
+    class guards with its own ``threading.Lock``s, then flag accesses of
+    those attributes outside any lock, blocking calls made while a lock is
+    held, and cross-class lock-acquisition cycles (deadlock candidates).
+  * **JAX hygiene** (`jax_hygiene.py`): host-sync idioms and ``os.environ``
+    reads inside functions reachable from ``jit``/``scan``/``shard_map``
+    bodies (stale-flag + recompile hazards), and ``jax.debug.callback``
+    sites not gated by a trace-time enablement check.
+  * **Drift invariants** (`dispatch.py`, `env_flags.py`, `legacy.py`):
+    every wire verb dispatched server-side needs a PROTOCOL.md row, chaos
+    coverage, and a test mention; every ``os.environ`` read needs a
+    ``utils/flags.py`` catalog entry; plus the four original ``check_*``
+    scripts (bare prints, metrics/docs drift, CLI-mode docs, quant
+    coverage) re-homed as analyzers.
+
+Intentional findings are suppressed via ``graftlint_baseline.json`` at the
+repo root — every entry must carry a reason string, and stale entries fail
+the run, so the baseline can only shrink unless someone argues in writing.
+
+Run it:  ``python -m scripts.graftlint [--json]``  (tier-1 runs the same
+driver through tests/test_graftlint.py).
+"""
+
+from .core import (  # noqa: F401
+    ALL_ANALYZERS,
+    Baseline,
+    BaselineError,
+    Context,
+    Finding,
+    build_context,
+    run_analyzers,
+)

@@ -1,0 +1,99 @@
+"""``python -m scripts.graftlint`` — run the analyzers, apply the baseline.
+
+Exit status:
+  0  no new findings, no stale baseline entries
+  1  new (non-baselined) findings, stale baseline entries, or a baseline
+     policy violation (missing reason, duplicate key, bad JSON)
+  2  usage error
+
+``--json`` emits a machine-readable report (new / suppressed / stale);
+``--no-baseline`` shows everything the analyzers see, which is how you
+author baseline entries in the first place.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import List, Optional
+
+from .core import (ALL_ANALYZERS, BASELINE_FILE, Baseline, BaselineError,
+                   build_context, run_analyzers)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m scripts.graftlint",
+        description="repo-native static analysis: lock discipline, JAX "
+                    "hygiene, dispatch/doc drift")
+    ap.add_argument("--analyzer", action="append", metavar="NAME",
+                    help="run only this analyzer (repeatable); choices: "
+                         + ", ".join(ALL_ANALYZERS))
+    ap.add_argument("--json", action="store_true",
+                    help="emit a JSON report instead of text")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore graftlint_baseline.json; report everything")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also list suppressed findings with their reasons")
+    ap.add_argument("--repo", type=pathlib.Path,
+                    default=pathlib.Path(__file__).resolve().parents[2],
+                    help="repo root (default: this checkout)")
+    args = ap.parse_args(argv)
+
+    ctx = build_context(args.repo)
+    try:
+        findings = run_analyzers(ctx, args.analyzer)
+    except KeyError as exc:
+        print(f"error: {exc.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.no_baseline:
+        baseline = Baseline({})
+    else:
+        try:
+            baseline = Baseline.load(args.repo / BASELINE_FILE)
+        except BaselineError as exc:
+            print(f"baseline policy violation: {exc}", file=sys.stderr)
+            return 1
+    new, suppressed, stale = baseline.split(findings)
+
+    # Stale entries only mean something when the full suite ran against
+    # the real baseline — a partial --analyzer run can't see every key.
+    check_stale = not args.no_baseline and not args.analyzer
+
+    if args.json:
+        print(json.dumps({
+            "new": [f.to_dict() for f in new],
+            "suppressed": [dict(f.to_dict(),
+                                reason=baseline.entries[f.key])
+                           for f in suppressed],
+            "stale_baseline_keys": stale if check_stale else [],
+            "analyzers": list(args.analyzer or ALL_ANALYZERS),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if args.show_baselined and suppressed:
+            print(f"-- {len(suppressed)} baselined finding(s):")
+            for f in suppressed:
+                print(f"  {f.key}\n      reason: "
+                      f"{baseline.entries[f.key]}")
+        if check_stale and stale:
+            print("stale baseline entries (finding no longer fires — "
+                  "remove them from graftlint_baseline.json):")
+            for k in stale:
+                print(f"  {k}")
+        if not new and not (check_stale and stale):
+            print(f"ok: graftlint clean "
+                  f"({len(findings)} finding(s), {len(suppressed)} "
+                  f"baselined, analyzers: "
+                  f"{', '.join(args.analyzer or ALL_ANALYZERS)})")
+    if new or (check_stale and stale):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,128 @@
+"""Shared AST plumbing for the graftlint analyzers.
+
+Pure stdlib ``ast`` — analyzers must never import the package under
+analysis (importing pulls in jax; the lint has to stay cheap enough for
+tier-1 and robust against modules that only import on-TPU).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class Module:
+    """One parsed source file."""
+
+    path: pathlib.Path          # absolute
+    rel: str                    # repo-relative, posix separators
+    tree: ast.Module
+    source: str
+
+
+def parse_tree(root: pathlib.Path, repo: pathlib.Path) -> List[Module]:
+    """Parse every ``*.py`` under `root` (skipping caches). A syntax error
+    is reported as a crash, not swallowed — unparsable code means the lint
+    is blind, which must fail loudly."""
+    mods = []
+    for path in sorted(root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        src = path.read_text(encoding="utf-8")
+        tree = ast.parse(src, filename=str(path))
+        mods.append(Module(path=path,
+                           rel=path.relative_to(repo).as_posix(),
+                           tree=tree, source=src))
+    return mods
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains; None for anything dynamic."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if isinstance(node, ast.Call):
+        # e.g. partial(jax.jit, ...)(f) — caller unwraps; no stable name.
+        return None
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def terminal_attr(call: ast.Call) -> Optional[str]:
+    """The last attribute of a call target: ``x.y.item()`` -> ``item``."""
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def is_self_attr(node: ast.AST, names: Optional[set] = None) -> Optional[str]:
+    """Return the attribute name when `node` is ``self.X`` (optionally only
+    for X in `names`)."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        if names is None or node.attr in names:
+            return node.attr
+    return None
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def walk_functions(tree: ast.Module
+                   ) -> Iterator[Tuple[str, Optional[str], ast.AST]]:
+    """Yield ``(qualname, class_name, funcdef)`` for every (async) function
+    in the module, including nested ones (qualname uses dots)."""
+
+    def rec(node: ast.AST, stack: List[str], cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = ".".join(stack + [child.name])
+                yield qn, cls, child
+                yield from rec(child, stack + [child.name], cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from rec(child, stack + [child.name], child.name)
+            else:
+                yield from rec(child, stack, cls)
+
+    yield from rec(tree, [], None)
+
+
+def enclosing_map(func: ast.AST) -> Dict[ast.AST, ast.AST]:
+    """child -> parent map for ancestor walks within one function body."""
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(func):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """local alias -> imported dotted source for ``import a.b as c`` and
+    ``from .mod import name`` (relative imports keep just the tail module
+    name — good enough for the name-based resolution the analyzers do)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for a in node.names:
+                out[a.asname or a.name] = (mod + "." if mod else "") + a.name
+    return out

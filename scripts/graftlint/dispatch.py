@@ -1,0 +1,115 @@
+"""Wire-verb drift invariants.
+
+The server-side dispatchers (``TcpStageServer._dispatch`` /
+``_gossip_dispatch``, ``RegistryServer._handle_verb``,
+``GatewayServer._dispatch`` — any method with those names in the package)
+are the ground truth for which verbs the swarm actually answers. For every
+verb literal compared against ``verb`` in those bodies:
+
+  * ``verb-undocumented``: no backticked row in docs/PROTOCOL.md. The
+    protocol doc is the interop contract — an undocumented verb is a
+    private fork of the wire format.
+  * ``verb-untested``: the verb string never appears in tests/. A verb
+    nobody exercises is a verb that breaks silently.
+  * ``verb-no-fault-injection``: the verb is never targeted by a
+    ``FaultRule(verb=...)`` anywhere (tests, scripts, package) and is not
+    in the read-only ``ADMIN_VERBS`` allowlist below. PR 3's contract:
+    data/control-plane verbs must be chaos-testable; introspection verbs
+    that carry no state are exempt by construction.
+
+Anchors are the verb names themselves, so baselines survive dispatcher
+refactors.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set
+
+from . import astutil
+from .core import Context, Finding
+
+DISPATCH_METHODS = {"_dispatch", "_gossip_dispatch", "_handle_verb"}
+
+# Read-only introspection verbs: they mutate nothing and return process-
+# local state, so there is no failure mode a FaultRule could meaningfully
+# exercise beyond the generic connection-level kinds every verb already
+# rides through (refuse_connect / reset_mid_frame fire on the socket, not
+# the verb). Anything NOT in this set needs a targeted fault rule or a
+# baseline entry with a reason.
+ADMIN_VERBS = {"metrics", "dump-events", "info", "list", "swarm-stats",
+               "reach_check", "fault"}
+
+_FAULT_RULE_RE = re.compile(r"""verb\s*=\s*["']([a-z0-9_-]+)["']""")
+
+
+def _verbs_in(fn: ast.AST) -> Dict[str, int]:
+    """verb literal -> first line, from comparisons against a ``verb``
+    variable (``verb == "x"``, ``verb in ("a", "b")``)."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not (isinstance(node.left, ast.Name)
+                and node.left.id == "verb"):
+            continue
+        for cmp_ in node.comparators:
+            if isinstance(cmp_, (ast.Tuple, ast.List, ast.Set)):
+                elts = cmp_.elts
+            else:
+                elts = [cmp_]
+            for e in elts:
+                v = astutil.str_const(e)
+                if v is not None:
+                    out.setdefault(v, node.lineno)
+    return out
+
+
+def analyze(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+
+    dispatched: Dict[str, List] = {}    # verb -> [(rel, line)]
+    for mod in ctx.modules:
+        for qn, cls, fn in astutil.walk_functions(mod.tree):
+            if fn.name not in DISPATCH_METHODS or cls is None:
+                continue
+            for verb, line in _verbs_in(fn).items():
+                dispatched.setdefault(verb, []).append((mod.rel, line))
+
+    # Fault-rule verb targets, gathered everywhere rules are declared.
+    fault_verbs: Set[str] = set()
+    corpora = [m.source for m in ctx.modules]
+    corpora += list(ctx.tests_text.values())
+    corpora += list(ctx.scripts_text.values())
+    for text in corpora:
+        if "FaultRule" not in text and "fault_rule" not in text:
+            continue
+        fault_verbs.update(_FAULT_RULE_RE.findall(text))
+
+    all_tests = "\n".join(ctx.tests_text.values())
+
+    for verb in sorted(dispatched):
+        rel, line = dispatched[verb][0]
+        if f"`{verb}`" not in ctx.protocol_text:
+            findings.append(Finding(
+                "verb-undocumented", rel, line, verb,
+                f"wire verb `{verb}` is dispatched here but has no "
+                "backticked row in docs/PROTOCOL.md — the protocol doc is "
+                "the interop contract"))
+        # Word-boundary, not quoted-literal: tests exercise verbs through
+        # client API methods (`transport.relay_attach(...)`), so requiring
+        # the wire literal would flag verbs with real coverage.
+        if not re.search(r"\b%s\b" % re.escape(verb), all_tests):
+            findings.append(Finding(
+                "verb-untested", rel, line, verb,
+                f"wire verb `{verb}` never appears in tests/ — it can "
+                "break without any tier-1 signal"))
+        if verb not in ADMIN_VERBS and verb not in fault_verbs:
+            findings.append(Finding(
+                "verb-no-fault-injection", rel, line, verb,
+                f"wire verb `{verb}` is never targeted by a "
+                "FaultRule(verb=...) and is not an allowlisted read-only "
+                "admin verb — state-carrying verbs must be "
+                "chaos-testable (PR 3 contract)"))
+    return findings

@@ -1,0 +1,99 @@
+"""Environment-flag catalog enforcement.
+
+``utils/flags.py`` is the single registry of environment variables the
+package reads: name, default, docstring, and whether the value is resolved
+at trace time (so toggling it after warmup requires a retrace — the
+INT8_FOLD / MOE_SPARSE class). This analyzer rejects drift:
+
+  * ``env-uncatalogued``: an ``os.environ`` / ``os.getenv`` read in
+    package code whose variable name has no catalog entry. Uncatalogued
+    flags are exactly how "works on my machine" serving configs happen.
+  * ``env-dynamic``: an env read whose variable name is not a string
+    literal — uncheckable, so disallowed in package code.
+  * ``env-catalog-missing``: utils/flags.py (or its FLAGS table) is gone.
+
+The catalog is read from flags.py's AST, never imported — the analyzer
+must not pull jax into a lint run.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from . import astutil
+from .core import Context, Finding
+
+ENV_GET_CALLS = {"os.environ.get", "os.getenv", "environ.get"}
+CATALOG_REL = "utils/flags.py"
+
+
+def catalog_names(ctx: Context) -> Optional[Set[str]]:
+    """Flag names declared in utils/flags.py: first string argument of
+    every ``Flag(...)`` call. None when the catalog module is missing."""
+    mod = ctx.module(CATALOG_REL)
+    if mod is None:
+        return None
+    names: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call)
+                and (astutil.call_name(node) or "").split(".")[-1]
+                == "Flag"):
+            if node.args:
+                v = astutil.str_const(node.args[0])
+                if v:
+                    names.add(v)
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    v = astutil.str_const(kw.value)
+                    if v:
+                        names.add(v)
+    return names
+
+
+def _env_read(node: ast.AST):
+    """(var_name_or_None, is_read) for env accesses; None node otherwise."""
+    if isinstance(node, ast.Call) and astutil.call_name(node) in ENV_GET_CALLS:
+        name = astutil.str_const(node.args[0]) if node.args else None
+        return (name, True)
+    if (isinstance(node, ast.Subscript)
+            and astutil.dotted_name(node.value) in ("os.environ", "environ")
+            and isinstance(node.ctx, ast.Load)):
+        return (astutil.str_const(node.slice), True)
+    return (None, False)
+
+
+def analyze(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    names = catalog_names(ctx)
+    if names is None or not names:
+        anchor_mod = ctx.modules[0].rel if ctx.modules else CATALOG_REL
+        findings.append(Finding(
+            "env-catalog-missing", anchor_mod, 1, "utils/flags.py",
+            "utils/flags.py env-flag catalog is missing or empty — every "
+            "environment variable the package reads must be declared "
+            "there (name, default, doc, trace-time marker)"))
+        names = set()
+    for mod in ctx.modules:
+        if mod.rel.endswith(CATALOG_REL):
+            continue        # the catalog implements the reads it declares
+        for qn, cls, fn in astutil.walk_functions(mod.tree):
+            for node in ast.walk(fn):
+                var, is_read = _env_read(node)
+                if not is_read:
+                    continue
+                if var is None:
+                    findings.append(Finding(
+                        "env-dynamic", mod.rel, node.lineno,
+                        f"{qn}:<dynamic>",
+                        f"env read with a non-literal variable name in "
+                        f"`{qn}` — uncheckable against the utils/flags.py "
+                        "catalog; use a literal"))
+                elif var not in names:
+                    findings.append(Finding(
+                        "env-uncatalogued", mod.rel, node.lineno,
+                        f"{qn}:{var}",
+                        f"env var `{var}` read in `{qn}` has no "
+                        "utils/flags.py catalog entry — declare its name, "
+                        "default, doc, and trace-time marker there"))
+    return findings

@@ -1,0 +1,299 @@
+"""JAX hygiene: what must never happen inside a traced body.
+
+Roots are functions handed to the tracing combinators — ``jax.jit`` /
+``pjit`` / ``shard_map`` (as decorators, including ``partial(jax.jit,
+...)``, or call sites) and ``lax.scan`` / ``lax.map`` / ``lax.cond`` /
+``lax.while_loop`` / ``lax.fori_loop`` bodies. From those roots a
+name-based call graph is walked across the whole package, and inside every
+reachable function three idiom families are flagged:
+
+  * ``jax-host-sync``: ``np.asarray``/``np.array``, ``.item()``,
+    ``.tolist()``, ``.block_until_ready()``, ``jax.device_get`` — a host
+    round-trip that serializes the dispatch pipeline (and, under ``jit``,
+    usually means a tracer leak or a silent constant-fold).
+  * ``jax-env-read``: ``os.environ`` / ``os.getenv`` reads. The value is
+    baked into the FIRST trace and invisible to the jit cache key — flag
+    flips after warmup silently do nothing (the ``int8_fold_enabled`` /
+    ``moe_sparse_enabled`` class of hazard). Resolve flags at trace time
+    in the caller and pass them in (or key the jit on them).
+  * ``jax-callback-ungated``: ``jax.debug.callback`` sites not lexically
+    inside an ``if ...enabled...:`` trace-time gate — an unconditional
+    callback costs a host transfer per step even with telemetry off (the
+    PR-11 contract: check enablement at trace time, emit nothing when
+    dark).
+
+Resolution is name-based and intra-package: imprecision shows up as a
+baselined finding with a reason, never as a silent pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import astutil
+from .core import Context, Finding
+
+TRACING_WRAPPERS = {"jit", "pjit", "shard_map"}
+LAX_COMBINATORS = {"scan", "map", "cond", "while_loop", "fori_loop",
+                   "switch", "associated_scan", "vmap"}
+HOST_SYNC_DOTTED = {"np.asarray", "np.array", "numpy.asarray",
+                    "numpy.array", "onp.asarray", "jax.device_get"}
+HOST_SYNC_TERMINAL = {"item", "tolist", "block_until_ready"}
+ENV_CALLS = {"os.environ.get", "os.getenv", "environ.get"}
+
+
+@dataclasses.dataclass
+class _Fn:
+    qualname: str                 # Class.method or function (module-local)
+    cls: Optional[str]
+    node: ast.AST                 # FunctionDef / Lambda
+    module: astutil.Module
+
+    @property
+    def key(self) -> Tuple[str, int]:
+        return (self.module.rel, id(self.node))
+
+
+class _Index:
+    """Name-based function resolution across the package."""
+
+    def __init__(self, modules: Sequence[astutil.Module]):
+        self.by_module_name: Dict[str, Dict[str, List[_Fn]]] = {}
+        self.methods: Dict[Tuple[str, str, str], List[_Fn]] = {}
+        self.aliases: Dict[str, Dict[str, str]] = {}
+        self.module_by_stem: Dict[str, List[astutil.Module]] = {}
+        for mod in modules:
+            stem = mod.path.stem
+            self.module_by_stem.setdefault(stem, []).append(mod)
+            self.aliases[mod.rel] = astutil.import_aliases(mod.tree)
+            names = self.by_module_name.setdefault(mod.rel, {})
+            for qn, cls, node in astutil.walk_functions(mod.tree):
+                fn = _Fn(qn, cls, node, mod)
+                names.setdefault(node.name, []).append(fn)
+                if cls is not None:
+                    self.methods.setdefault(
+                        (mod.rel, cls, node.name), []).append(fn)
+
+    def resolve(self, call: ast.Call, mod: astutil.Module,
+                cls: Optional[str]) -> List[_Fn]:
+        name = astutil.call_name(call)
+        if name is None:
+            return []
+        parts = name.split(".")
+        if parts[0] == "self" and len(parts) == 2 and cls is not None:
+            return self.methods.get((mod.rel, cls, parts[1]), [])
+        if len(parts) == 1:
+            local = self.by_module_name.get(mod.rel, {}).get(parts[0], [])
+            if local:
+                return local
+            src = self.aliases.get(mod.rel, {}).get(parts[0])
+            if src:
+                return self._from_source(src)
+            return []
+        if len(parts) == 2:
+            # mod_alias.f(...): find the aliased module, then f in it.
+            src = self.aliases.get(mod.rel, {}).get(parts[0])
+            if src:
+                return self._from_source(src + "." + parts[1])
+        return []
+
+    def _from_source(self, dotted: str) -> List[_Fn]:
+        """Resolve "…modname.funcname" against package modules by stem."""
+        parts = [p for p in dotted.split(".") if p]
+        if len(parts) < 2:
+            return []
+        modname, func = parts[-2], parts[-1]
+        out: List[_Fn] = []
+        for m in self.module_by_stem.get(modname, []):
+            for fn in self.by_module_name.get(m.rel, {}).get(func, []):
+                if fn.cls is None:
+                    out.append(fn)
+        return out
+
+
+def _scope_walk(node: ast.AST):
+    """Walk a function/module body without descending into nested function
+    definitions (those are separate scopes with their own entries)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _is_tracing_call(name: Optional[str]) -> Optional[str]:
+    """Return the combinator kind when `name` is a tracing entry point."""
+    if not name:
+        return None
+    parts = name.split(".")
+    tail = parts[-1]
+    if tail in TRACING_WRAPPERS:
+        return tail
+    if tail in LAX_COMBINATORS and len(parts) > 1 \
+            and parts[-2] in ("lax", "jax"):
+        return tail
+    return None
+
+
+def _traced_args(call: ast.Call, kind: str) -> List[ast.AST]:
+    args = call.args
+    if kind in TRACING_WRAPPERS or kind in ("scan", "map", "vmap",
+                                            "associated_scan"):
+        return args[:1]
+    if kind == "cond":
+        return list(args[1:3])
+    if kind == "switch":
+        return list(args[1:2])
+    if kind == "while_loop":
+        return list(args[:2])
+    if kind == "fori_loop":
+        return list(args[2:3])
+    return []
+
+
+def _unwrap_partial(node: ast.AST) -> ast.AST:
+    while (isinstance(node, ast.Call)
+           and (astutil.call_name(node) or "").split(".")[-1] == "partial"
+           and node.args):
+        node = node.args[0]
+    return node
+
+
+def _decorator_traces(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        for sub in ast.walk(dec):
+            name = astutil.dotted_name(sub)
+            if name and name.split(".")[-1] in TRACING_WRAPPERS:
+                return True
+    return False
+
+
+def _collect_roots(ctx: Context, index: _Index) -> List[Tuple[_Fn, str]]:
+    """(fn, why) for every function whose body is traced."""
+    roots: List[Tuple[_Fn, str]] = []
+    for mod in ctx.modules:
+        scopes: List[Tuple[str, Optional[str], ast.AST]] = [
+            ("<module>", None, mod.tree)]
+        scopes.extend(astutil.walk_functions(mod.tree))
+        for qn, cls, scope in scopes:
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _decorator_traces(scope):
+                roots.append((_Fn(qn, cls, scope, mod),
+                              f"decorated in {mod.rel}"))
+            for node in _scope_walk(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = _is_tracing_call(astutil.call_name(node))
+                if kind is None:
+                    continue
+                for arg in _traced_args(node, kind):
+                    arg = _unwrap_partial(arg)
+                    if isinstance(arg, ast.Lambda):
+                        roots.append((_Fn(f"{qn}.<lambda>", cls, arg, mod),
+                                      f"{kind} at {mod.rel}:{node.lineno}"))
+                    elif isinstance(arg, (ast.Name, ast.Attribute)):
+                        fake = ast.Call(func=arg, args=[], keywords=[])
+                        for fn in index.resolve(fake, mod, cls):
+                            roots.append(
+                                (fn, f"{kind} at {mod.rel}:{node.lineno}"))
+    return roots
+
+
+def _is_env_read(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        return astutil.call_name(node) in ENV_CALLS
+    if isinstance(node, ast.Subscript):
+        return astutil.dotted_name(node.value) in ("os.environ", "environ")
+    return False
+
+
+def analyze(ctx: Context) -> List[Finding]:
+    index = _Index(ctx.modules)
+    findings: List[Finding] = []
+
+    # -- reachability sweep -------------------------------------------------
+    roots = _collect_roots(ctx, index)
+    queue: List[Tuple[_Fn, str]] = list(roots)
+    visited: Set[Tuple[str, int]] = set()
+    while queue:
+        fn, why = queue.pop()
+        if fn.key in visited:
+            continue
+        visited.add(fn.key)
+        for node in _scope_walk(fn.node):
+            if isinstance(node, ast.Call):
+                name = astutil.call_name(node)
+                term = astutil.terminal_attr(node)
+                if name in HOST_SYNC_DOTTED or (
+                        term in HOST_SYNC_TERMINAL and name != term):
+                    findings.append(Finding(
+                        "jax-host-sync", fn.module.rel, node.lineno,
+                        f"{fn.qualname}:{name or term}",
+                        f"host-sync idiom `{name or term}` in "
+                        f"`{fn.qualname}`, reachable from a traced body "
+                        f"({why}) — forces a device round-trip or bakes a "
+                        "constant into the trace"))
+                if astutil.call_name(node) in ENV_CALLS:
+                    findings.append(Finding(
+                        "jax-env-read", fn.module.rel, node.lineno,
+                        f"{fn.qualname}:environ",
+                        f"os.environ read in `{fn.qualname}`, reachable "
+                        f"from a traced body ({why}) — the value is baked "
+                        "into the first trace and invisible to the jit "
+                        "cache key; resolve it at trace time in the "
+                        "caller"))
+                for callee in index.resolve(node, fn.module, fn.cls):
+                    if callee.key not in visited:
+                        queue.append(
+                            (callee, f"via {fn.qualname} ({why})"))
+                kind = _is_tracing_call(name)
+                if kind:
+                    for arg in _traced_args(node, kind):
+                        arg = _unwrap_partial(arg)
+                        if isinstance(arg, (ast.Name, ast.Attribute)):
+                            fake = ast.Call(func=arg, args=[], keywords=[])
+                            for callee in index.resolve(fake, fn.module,
+                                                        fn.cls):
+                                if callee.key not in visited:
+                                    queue.append((callee, why))
+            elif isinstance(node, ast.Subscript) and _is_env_read(node):
+                findings.append(Finding(
+                    "jax-env-read", fn.module.rel, node.lineno,
+                    f"{fn.qualname}:environ",
+                    f"os.environ subscript in `{fn.qualname}`, reachable "
+                    f"from a traced body ({why}) — stale-flag hazard"))
+
+    # -- callback gating (whole package, reachable or not) ------------------
+    for mod in ctx.modules:
+        for qn, cls, fnode in astutil.walk_functions(mod.tree):
+            parents = None
+            for node in _scope_walk(fnode):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = astutil.call_name(node) or ""
+                if not name.endswith("debug.callback"):
+                    continue
+                if parents is None:
+                    parents = astutil.enclosing_map(fnode)
+                gated = False
+                cur = node
+                while cur in parents:
+                    cur = parents[cur]
+                    if isinstance(cur, ast.If):
+                        test_src = ast.unparse(cur.test)
+                        if "enabled" in test_src.lower():
+                            gated = True
+                            break
+                if not gated:
+                    findings.append(Finding(
+                        "jax-callback-ungated", mod.rel, node.lineno,
+                        f"{qn}:debug.callback",
+                        f"`jax.debug.callback` in `{qn}` is not inside an "
+                        "`if ...enabled...:` trace-time gate — it will "
+                        "cost a host transfer per step even with "
+                        "telemetry off"))
+    return findings

@@ -1,0 +1,426 @@
+"""Lock-discipline analysis: per-class guarded-attribute inference.
+
+Model (per class): attributes this class ever WRITES inside a
+``with self.<lock>:`` block are *guarded* — the class has declared, by
+example, that they are shared mutable state. Three rules follow:
+
+  * ``lock-unguarded-attr``: a read or write of a guarded attribute
+    lexically outside any of the class's lock regions, in any method other
+    than ``__init__`` (construction happens before the object is shared).
+    Helper methods whose name ends in ``_locked``, or which are only ever
+    called from inside lock regions of the same class, count as locked
+    context (the repo's existing ``_apply_locked``/``_gc_locked``
+    convention, generalized).
+  * ``lock-blocking-call``: a blocking call (socket I/O, ``time.sleep``,
+    ``block_until_ready``, wire-frame send/recv, subprocess) made while a
+    lock is held — including local per-connection locks (any ``with`` on a
+    name containing "lock"). One stalled peer must never stall every
+    thread waiting on the lock.
+  * ``lock-order-cycle``: class A calls, while holding its own lock, a
+    method that acquires class B's lock, and vice versa — a deadlock
+    candidate. Matching is name-based (A's locked region calls ``x.m()``
+    and some class B defines ``m`` acquiring B's own lock), so cycles are
+    *candidates* for triage, not verdicts.
+
+Everything is lexical and intraprocedural by design: cheap, deterministic,
+zero-import. Intentional exceptions go in the baseline with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import astutil
+from .core import Context, Finding
+
+LOCK_FACTORIES = {"threading.Lock", "threading.RLock", "threading.Condition",
+                  "Lock", "RLock", "Condition"}
+
+# Calls that block the calling thread. Dotted names match exactly;
+# terminal attributes match any receiver (imprecise on purpose — a
+# baselined false positive is cheaper than a missed convoy).
+BLOCKING_DOTTED = {"time.sleep", "socket.create_connection",
+                   "subprocess.run", "subprocess.check_call",
+                   "subprocess.check_output", "subprocess.Popen"}
+BLOCKING_TERMINAL = {"recv", "recv_into", "recvfrom", "sendall", "accept",
+                     "connect", "connect_ex", "getaddrinfo",
+                     "block_until_ready", "wait", "create_connection"}
+BLOCKING_BARE = {"_send_frame", "_recv_frame"}
+
+IGNORED_METHODS = {"__init__", "__del__"}
+
+# Methods that MUTATE their receiver: `self.X.append(...)` under a lock
+# marks X guarded just like `self.X = ...` would.
+MUTATORS = {"append", "appendleft", "add", "discard", "remove", "clear",
+            "update", "setdefault", "pop", "popleft", "popitem", "extend",
+            "insert", "push"}
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    write: bool
+    line: int
+    locked: bool
+
+
+@dataclasses.dataclass
+class _CallSite:
+    name: Optional[str]         # dotted name if static
+    terminal: Optional[str]     # last attr / bare name
+    line: int
+    self_locked: bool           # under a `with self.<lock>` region
+    any_locked: bool            # under any lock-ish `with` (incl. locals)
+    receiver_self_attr: Optional[str]  # X for `self.X.m()` calls
+    held_ctxs: Tuple[str, ...] = ()    # dotted names of enclosing lock ctxs
+
+
+@dataclasses.dataclass
+class _MethodInfo:
+    name: str
+    accesses: List[_Access] = dataclasses.field(default_factory=list)
+    calls: List[_CallSite] = dataclasses.field(default_factory=list)
+    self_calls: List[Tuple[str, bool]] = dataclasses.field(
+        default_factory=list)            # (method, locked at call site)
+    acquires_self_lock: bool = False
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    name: str
+    rel: str
+    line: int
+    lock_attrs: Set[str]
+    methods: Dict[str, _MethodInfo]
+    held: Set[str] = dataclasses.field(default_factory=set)
+
+    def lock_acquiring_methods(self) -> Set[str]:
+        out = {m for m, mi in self.methods.items() if mi.acquires_self_lock}
+        out |= {m for m in self.held if m in self.methods}
+        return out
+
+
+def _lock_attrs_of(cls: ast.ClassDef) -> Set[str]:
+    """Names X with ``self.X = threading.Lock()`` anywhere in the class,
+    plus ``self.X = <local previously bound to a Lock()>`` and the
+    ``*_lock``-named-attr-assigned-in-__init__ fallback."""
+    locks: Set[str] = set()
+    for fn in (n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))):
+        local_locks: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            is_factory = (isinstance(node.value, ast.Call)
+                          and astutil.call_name(node.value)
+                          in LOCK_FACTORIES)
+            from_local = (isinstance(node.value, ast.Name)
+                          and node.value.id in local_locks)
+            for tgt in node.targets:
+                tgts = (tgt.elts if isinstance(tgt, (ast.Tuple, ast.List))
+                        else [tgt])
+                vals = (node.value.elts
+                        if isinstance(node.value, (ast.Tuple, ast.List))
+                        and isinstance(tgt, (ast.Tuple, ast.List))
+                        and len(node.value.elts) == len(tgts)
+                        else None)
+                for i, t in enumerate(tgts):
+                    v = vals[i] if vals is not None else node.value
+                    v_is_lock = (
+                        (isinstance(v, ast.Call)
+                         and astutil.call_name(v) in LOCK_FACTORIES)
+                        or (isinstance(v, ast.Name)
+                            and v.id in local_locks)
+                        or (is_factory and vals is None)
+                        or (from_local and vals is None))
+                    a = astutil.is_self_attr(t)
+                    if a and v_is_lock:
+                        locks.add(a)
+                    elif (a and fn.name == "__init__"
+                          and a.endswith("lock")):
+                        locks.add(a)
+                    elif (isinstance(t, ast.Name)
+                          and isinstance(v, ast.Call)
+                          and astutil.call_name(v) in LOCK_FACTORIES):
+                        local_locks.add(t.id)
+    return locks
+
+
+def _is_lockish_name(node: ast.AST) -> bool:
+    """A `with` context that is *some* lock but not `self.X`: a local name
+    (or attribute) containing "lock" — e.g. the per-connection send locks
+    the relay pool vends."""
+    name = astutil.dotted_name(node)
+    return bool(name) and "lock" in name.split(".")[-1].lower()
+
+
+class _MethodWalker(ast.NodeVisitor):
+    def __init__(self, lock_attrs: Set[str], info: _MethodInfo):
+        self.lock_attrs = lock_attrs
+        self.info = info
+        self.self_depth = 0
+        self.any_depth = 0
+        self.held_ctxs: List[str] = []   # dotted names of held lock ctxs
+
+    # -- lock regions -------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        self_hit = any_hit = 0
+        names: List[str] = []
+        for item in node.items:
+            cx = item.context_expr
+            if astutil.is_self_attr(cx, self.lock_attrs):
+                self_hit += 1
+                any_hit += 1
+                names.append(astutil.dotted_name(cx) or "")
+            elif _is_lockish_name(cx):
+                any_hit += 1
+                names.append(astutil.dotted_name(cx) or "")
+            else:
+                self.visit(cx)       # a non-lock context still has exprs
+        if self_hit:
+            self.info.acquires_self_lock = True
+        self.self_depth += self_hit
+        self.any_depth += any_hit
+        self.held_ctxs.extend(names)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.held_ctxs[len(self.held_ctxs) - len(names):]
+        self.self_depth -= self_hit
+        self.any_depth -= any_hit
+
+    visit_AsyncWith = visit_With
+
+    # -- attribute accesses -------------------------------------------------
+
+    def _locked(self) -> bool:
+        return self.self_depth > 0
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = astutil.is_self_attr(node)
+        if attr and attr not in self.lock_attrs:
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self.info.accesses.append(
+                _Access(attr, write, node.lineno, self._locked()))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # `self.X[k] = v` / `del self.X[k]` mutate X (a read of X plus a
+        # write through it) — record the write on X itself.
+        attr = astutil.is_self_attr(node.value)
+        if attr and isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.info.accesses.append(
+                _Access(attr, True, node.lineno, self._locked()))
+        self.generic_visit(node)
+
+    # -- calls --------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = astutil.call_name(node)
+        terminal = astutil.terminal_attr(node)
+        recv_attr = None
+        if isinstance(node.func, ast.Attribute):
+            recv_attr = astutil.is_self_attr(node.func.value)
+            if recv_attr and terminal in MUTATORS:
+                # self.X.append(...) is a write to X.
+                self.info.accesses.append(
+                    _Access(recv_attr, True, node.lineno, self._locked()))
+            if (isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"):
+                self.info.self_calls.append((node.func.attr, self._locked()))
+        self.info.calls.append(_CallSite(
+            name=name, terminal=terminal, line=node.lineno,
+            self_locked=self._locked(), any_locked=self.any_depth > 0,
+            receiver_self_attr=recv_attr,
+            held_ctxs=tuple(self.held_ctxs)))
+        self.generic_visit(node)
+
+    # Nested defs/lambdas run later, not under the current lock — but their
+    # bodies still belong to this class's text. Walk them with lock state
+    # reset so a closure's accesses aren't credited with the def site's lock.
+    def _nested(self, node) -> None:
+        saved = self.self_depth, self.any_depth
+        self.self_depth = self.any_depth = 0
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self.self_depth, self.any_depth = saved
+
+    visit_FunctionDef = _nested
+    visit_AsyncFunctionDef = _nested
+    visit_Lambda = _nested
+
+
+def _analyze_class(cls: ast.ClassDef, rel: str) -> Optional[_ClassInfo]:
+    lock_attrs = _lock_attrs_of(cls)
+    if not lock_attrs:
+        return None
+    methods: Dict[str, _MethodInfo] = {}
+    for fn in (n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))):
+        info = _MethodInfo(fn.name)
+        walker = _MethodWalker(lock_attrs, info)
+        for stmt in fn.body:
+            walker.visit(stmt)
+        methods[fn.name] = info
+    ci = _ClassInfo(cls.name, rel, cls.lineno, lock_attrs, methods)
+
+    # Held-method closure: *_locked by convention, then any method whose
+    # every intra-class call site is itself in locked context, to fixpoint.
+    held = {m for m in methods if m.endswith("_locked")}
+    changed = True
+    while changed:
+        changed = False
+        sites: Dict[str, List[bool]] = {}
+        for caller, mi in methods.items():
+            caller_locked = caller in held
+            for callee, locked in mi.self_calls:
+                sites.setdefault(callee, []).append(locked or caller_locked)
+        for m in methods:
+            if m in held or m in IGNORED_METHODS:
+                continue
+            if sites.get(m) and all(sites[m]):
+                held.add(m)
+                changed = True
+    ci.held = held
+    return ci
+
+
+def _effective(locked: bool, method: str, ci: _ClassInfo) -> bool:
+    return locked or method in ci.held
+
+
+def _is_blocking(site: _CallSite, lock_attrs: Set[str]) -> bool:
+    if site.name in BLOCKING_DOTTED:
+        return True
+    if site.name in BLOCKING_BARE:
+        return True
+    if site.terminal in BLOCKING_TERMINAL and site.name != site.terminal:
+        # Condition.wait on one of the class's own locks is the sanctioned
+        # blocking idiom: the runtime requires holding a condition's lock
+        # to wait on it, and wait() RELEASES that lock while parked (this
+        # covers `Condition(self._lock)` sharing too — kv_cache/sp_serve).
+        # Waiting while a second, different lock is also held still
+        # convoys, and stays flagged.
+        if (site.terminal == "wait" and site.name
+                and len(site.held_ctxs) <= 1):
+            parts = site.name.split(".")
+            if (len(parts) == 3 and parts[0] == "self"
+                    and parts[1] in lock_attrs):
+                return False
+        return True
+    return False
+
+
+def analyze(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    classes: List[_ClassInfo] = []
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                ci = _analyze_class(node, mod.rel)
+                if ci is not None:
+                    classes.append(ci)
+
+    for ci in classes:
+        # Guarded set: attrs written under lock outside construction.
+        guarded: Set[str] = set()
+        for m, mi in ci.methods.items():
+            if m in IGNORED_METHODS:
+                continue
+            for a in mi.accesses:
+                if a.write and _effective(a.locked, m, ci):
+                    guarded.add(a.attr)
+
+        for m, mi in ci.methods.items():
+            if m in IGNORED_METHODS:
+                continue
+            for a in mi.accesses:
+                if (a.attr in guarded
+                        and not _effective(a.locked, m, ci)):
+                    kind = "write" if a.write else "read"
+                    findings.append(Finding(
+                        "lock-unguarded-attr", ci.rel, a.line,
+                        f"{ci.name}.{m}:{a.attr}",
+                        f"{kind} of `{a.attr}` outside the lock, but "
+                        f"`{ci.name}` writes it under "
+                        f"`with self.{'/'.join(sorted(ci.lock_attrs))}` "
+                        f"elsewhere — unguarded shared state"))
+            for c in mi.calls:
+                held_method = m in ci.held
+                if (c.any_locked or c.self_locked or held_method) \
+                        and _is_blocking(c, ci.lock_attrs):
+                    callee = c.name or c.terminal or "?"
+                    findings.append(Finding(
+                        "lock-blocking-call", ci.rel, c.line,
+                        f"{ci.name}.{m}:{callee}",
+                        f"blocking call `{callee}` while a lock is held — "
+                        "one stalled peer stalls every thread contending "
+                        "for it"))
+
+    # -- cross-class lock-order graph --------------------------------------
+    # Edge A->B: A's locked region calls `x.m()` where m is a
+    # lock-ACQUIRING method of exactly one class (B) package-wide. The
+    # uniqueness requirement is the precision lever: generic names like
+    # `get`/`clear`/`observe` live in many lockful classes and would
+    # otherwise weave phantom cycles through every registry.
+    acquiring: Dict[str, Set[str]] = {}      # method name -> classes
+    by_name: Dict[str, _ClassInfo] = {}
+    for ci in classes:
+        by_name[ci.name] = ci
+        for m, mi in ci.methods.items():
+            if mi.acquires_self_lock:
+                acquiring.setdefault(m, set()).add(ci.name)
+
+    edges: Dict[str, Dict[str, Tuple[int, str]]] = {}
+    for ci in classes:
+        for m, mi in ci.methods.items():
+            if m in IGNORED_METHODS:
+                continue
+            for c in mi.calls:
+                if not (c.self_locked or m in ci.held):
+                    continue
+                if c.terminal is None or c.name == c.terminal:
+                    continue      # bare function, not a method call
+                if c.terminal in MUTATORS:
+                    continue      # deque.clear()/list.pop() etc. — container
+                                  # ops share names with lockful classes'
+                                  # methods and weave phantom cycles
+                if c.name and c.name.startswith("self."):
+                    recv_parts = c.name.split(".")
+                    if len(recv_parts) == 2:
+                        continue  # self.m() — intra-class
+                targets = acquiring.get(c.terminal, set()) - {ci.name}
+                if len(targets) != 1:
+                    continue      # unresolvable or ambiguous method name
+                target = next(iter(targets))
+                edges.setdefault(ci.name, {}).setdefault(
+                    target, (c.line, m))
+
+    # Cycle detection (simple DFS; graphs here are tiny).
+    cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str], seen: Set[str]):
+        for nxt in edges.get(node, {}):
+            if nxt == start and len(path) > 1:
+                lo = path.index(min(path))
+                cycles.add(tuple(path[lo:] + path[:lo]))
+            elif nxt not in seen:
+                seen.add(nxt)
+                dfs(start, nxt, path + [nxt], seen)
+
+    for n in list(edges):
+        dfs(n, n, [n], {n})
+
+    for cyc in sorted(cycles):
+        first = by_name[cyc[0]]
+        line, method = edges[cyc[0]][cyc[1 % len(cyc)]]
+        chain = " -> ".join(cyc + (cyc[0],))
+        findings.append(Finding(
+            "lock-order-cycle", first.rel, line,
+            f"cycle:{'->'.join(cyc)}",
+            f"lock-acquisition cycle {chain}: each class calls into the "
+            "next while holding its own lock — deadlock candidate "
+            "(name-based match; verify call targets)"))
+    return findings

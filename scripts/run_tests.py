@@ -78,7 +78,7 @@ SHARDS = [
     # in-process swarms — grouped so their compiles share one process
     # without crowding the engine shards)
     ["test_events.py", "test_faults.py", "test_gossip.py",
-     "test_profiling.py", "test_telemetry.py"],
+     "test_graftlint.py", "test_profiling.py", "test_telemetry.py"],
 ]
 
 

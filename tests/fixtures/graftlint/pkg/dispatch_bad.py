@@ -1,0 +1,8 @@
+"""Seeded wire-verb drift (parsed by graftlint, never run)."""
+
+
+class PhantomServer:
+    def _dispatch(self, sock, verb, header):
+        if verb == "phantom_verb":   # no doc row, no test, no fault rule
+            return {"ok": True}
+        return None

@@ -1,0 +1,7 @@
+"""Seeded env-catalog violation (parsed by graftlint, never run)."""
+
+import os
+
+
+def read_uncatalogued():
+    return os.environ.get("NOT_IN_CATALOG", "")   # -> env-uncatalogued

@@ -1,0 +1,21 @@
+"""Seeded JAX-hygiene violations (parsed by graftlint, never run)."""
+
+import os
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def traced_step(x):
+    return helper(x)
+
+
+def helper(x):
+    flag = os.environ.get("JAX_BAD_FLAG", "0")   # -> jax-env-read
+    host = np.asarray(x)                         # -> jax-host-sync
+    return x * (1 if flag == "1" else 2) + host.shape[0]
+
+
+def emit_debug(x):
+    jax.debug.callback(lambda v: None, x)        # -> jax-callback-ungated

@@ -1,0 +1,46 @@
+"""Seeded lock-discipline violations (parsed by graftlint, never run)."""
+
+import threading
+import time
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def inc(self):
+        with self._lock:
+            self._count += 1
+
+    def peek(self):
+        return self._count          # unguarded read -> lock-unguarded-attr
+
+    def slow_inc(self):
+        with self._lock:
+            time.sleep(0.1)         # -> lock-blocking-call
+            self._count += 1
+
+
+class Alpha:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.beta = None
+        self._a = 0
+
+    def alpha_touch(self):
+        with self._lock:
+            self._a += 1
+            self.beta.beta_touch()   # holds Alpha's lock, takes Beta's
+
+
+class Beta:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.alpha = None
+        self._b = 0
+
+    def beta_touch(self):
+        with self._lock:
+            self._b += 1
+            self.alpha.alpha_touch()  # -> lock-order-cycle Alpha<->Beta

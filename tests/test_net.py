@@ -141,6 +141,17 @@ def test_info_verb(swarm):
     assert info["version"] == 1
 
 
+def test_swarm_stats_verb(swarm):
+    """`swarm-stats` answers with the peer's own digest plus its gossip
+    records — registry-free input for `--mode top` (PROTOCOL.md row)."""
+    cfg, params, client, transport, servers, _ = swarm
+    peer = servers[0].executor.peer_id
+    view = transport.swarm_stats(peer)
+    assert view["peer_id"] == peer
+    assert "self" in view
+    assert isinstance(view["records"], list)
+
+
 def test_bf16_wire_generation_completes():
     """bf16 wire (reference ships fp16): halved payloads, generation runs."""
     cfg = tiny_cfg()
