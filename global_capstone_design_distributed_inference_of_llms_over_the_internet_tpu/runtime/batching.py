@@ -65,6 +65,7 @@ from ..parallel.ring_attention import NEG_INF
 from ..telemetry import catalog as _tm
 from ..telemetry import events as _ev
 from ..telemetry.profiling import get_profiler as _get_profiler
+from .errors import register as _catalog
 from .kv_cache import round_to_bucket
 
 Params = Dict[str, Any]
@@ -95,6 +96,7 @@ PREFILL_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
 BURST_REPEAT_STOP = 5
 
 
+@_catalog
 class SlotFull(RuntimeError):
     """No free slot (admission control — the caller queues or fails over)."""
 

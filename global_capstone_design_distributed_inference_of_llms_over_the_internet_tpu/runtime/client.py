@@ -45,6 +45,8 @@ from ..telemetry import MetricsRegistry, get_tracer
 from ..telemetry import catalog as _tm
 from ..telemetry import events as _ev
 from ..telemetry.profiling import get_profiler as _get_profiler
+from . import errors as _errors
+from .errors import register as _catalog
 from .executor import StageExecutionError, StageExecutor
 from .messages import StageRequest, StageResponse, clip_generated
 from .transport import DeadlineExceeded, PeerUnavailable, Transport
@@ -101,6 +103,7 @@ def _soft_filter(items, pred):
     return kept or items
 
 
+@_catalog
 class NoRouteError(RuntimeError):
     """No live servers cover the required span (route computation failed)."""
 
@@ -767,13 +770,13 @@ class PipelineClient:
                 # failover attempt can only spend more of it. Never counts
                 # against the peer (it did the right thing by refusing).
                 raise
-            # Retryable taxonomy: connectivity faults + server-side session
+            # Retryable taxonomy (runtime/errors.py, the same table
+            # graftlint checks): connectivity faults + server-side session
             # loss (StageExecutionError — failover+replay rebuilds the KV).
             # Deliberately NOT the reference's broad RuntimeError/ValueError
             # net (src/rpc_transport.py:618): a deterministic client-side bug
             # would blacklist every healthy replica in turn.
-            except (PeerUnavailable, TimeoutError, ConnectionError,
-                    StageExecutionError) as exc:
+            except _errors.retryable_types() as exc:
                 if not isinstance(exc, _BreakerOpen):
                     # A skipped dial is not evidence about the peer. Breaker
                     # blame may differ from routing blame: a RELAYED hop's
@@ -781,7 +784,7 @@ class PipelineClient:
                     # — opening the hop's own breaker would blacklist every
                     # peer behind one dead relay.
                     self.breaker.record_failure(
-                        getattr(exc, "breaker_peer_id", None) or hop.peer_id)
+                        _errors.breaker_blame(exc, hop.peer_id))
                 last_exc = exc
                 self._m_retries.inc()
                 trace_id = (req.trace or {}).get("trace_id") \
@@ -811,7 +814,10 @@ class PipelineClient:
                          new_peer=replacement)
                 try:
                     self._replay(hop, req.session_id, req.sampling, req.max_length)
-                except Exception as replay_exc:  # replacement died too
+                except _errors.retryable_types() as replay_exc:
+                    # Replacement died too: blacklist it and keep failing
+                    # over. Permanent failures (e.g. DeadlineExceeded mid-
+                    # replay) propagate — retrying cannot help them.
                     last_exc = replay_exc
                     failed.add(replacement)
                     continue
@@ -1192,17 +1198,16 @@ class PipelineClient:
             except DeadlineExceeded:
                 chain_span.end(error="deadline")
                 raise  # terminal: retrying spends a budget already blown
-            except (PeerUnavailable, TimeoutError, ConnectionError,
-                    StageExecutionError) as exc:
+            except _errors.retryable_types() as exc:
                 # Breaker blame prefers the failing COMPONENT over the
-                # routing-blamed hop: a PushChainError whose breaker_peer_id
-                # names a relay volunteer opens the VOLUNTEER's breaker (the
-                # relayed peer behind it may be perfectly healthy), while
+                # routing-blamed hop (runtime/errors.py BLAME_BREAKER): a
+                # PushChainError whose breaker_peer_id names a relay
+                # volunteer opens the VOLUNTEER's breaker (the relayed peer
+                # behind it may be perfectly healthy), while
                 # _blame_chain_failure below still blacklists the hop so the
                 # next route avoids it.
-                self.breaker.record_failure(
-                    getattr(exc, "breaker_peer_id", None)
-                    or getattr(exc, "peer_id", None) or hops[0].peer_id)
+                self.breaker.record_failure(_errors.breaker_blame(
+                    exc, getattr(exc, "peer_id", None) or hops[0].peer_id))
                 chain_span.end(error=repr(exc))
                 last_exc = exc
                 self._m_retries.inc()
@@ -1219,8 +1224,7 @@ class PipelineClient:
                 except NoRouteError as rexc:
                     last_exc = rexc
                     continue
-                except (PeerUnavailable, TimeoutError, ConnectionError,
-                        StageExecutionError) as rexc:
+                except _errors.retryable_types() as rexc:
                     # A peer died DURING replay: blame it too so the next
                     # attempt routes around it instead of repeating the
                     # identical failing chain.

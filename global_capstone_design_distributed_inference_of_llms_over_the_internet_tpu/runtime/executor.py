@@ -47,6 +47,7 @@ from ..ops.sampling import RECENT_WINDOW, sample_token
 from ..models.transformer import stack_forward_train
 from ..telemetry import events as _ev
 from ..utils.platform import engine_donation
+from .errors import register as _catalog
 from .kv_cache import AllocationFailed, KVArena, KVHandle, round_to_bucket
 from .messages import (
     BackwardRequest,
@@ -60,6 +61,7 @@ logger = logging.getLogger(__name__)
 SEQ_BUCKETS = (1, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
 
+@_catalog
 class StageExecutionError(RuntimeError):
     """Server-side hard error (maps to the RuntimeError raised at
     ``src/rpc_handler.py:198-202`` for decode-without-cache)."""

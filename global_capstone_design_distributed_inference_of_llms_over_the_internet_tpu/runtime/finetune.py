@@ -35,6 +35,7 @@ from ..models.config import ModelConfig
 from ..models.transformer import embed_tokens, lm_head, stack_forward_train
 from ..parallel.trainer import adamw_init, adamw_update, softmax_xent
 from .client import NoRouteError, PipelineClient
+from .errors import retryable_types
 from .executor import StageExecutionError
 from .messages import BackwardRequest, StageRequest
 from .transport import PeerUnavailable
@@ -206,8 +207,7 @@ class DistributedFineTuner:
                 resp = self.client.transport.call(
                     hop.peer_id, req, timeout=self.client.request_timeout
                 )
-            except (PeerUnavailable, TimeoutError, ConnectionError,
-                    StageExecutionError) as exc:
+            except retryable_types() as exc:
                 self._mark_failed(hop, exc)
                 raise _HopFailed from exc
             h = jnp.asarray(resp.hidden)
@@ -232,8 +232,7 @@ class DistributedFineTuner:
                 bresp = self.client.transport.backward(
                     hop.peer_id, breq, timeout=self.client.request_timeout
                 )
-            except (PeerUnavailable, TimeoutError, ConnectionError,
-                    StageExecutionError) as exc:
+            except retryable_types() as exc:
                 self._mark_failed(hop, exc)
                 raise _HopFailed from exc
             grad_out = jnp.asarray(bresp.grad_input)

@@ -39,13 +39,16 @@ import jax.numpy as jnp
 
 from ..telemetry import catalog as _tm
 from ..telemetry import events as _ev
+from .errors import register as _catalog
 
 
+@_catalog
 class AllocationFailed(RuntimeError):
     """Raised when the arena cannot satisfy an allocation within the timeout
     (mirrors ``petals/server/memory_cache.py:224-225``)."""
 
 
+@_catalog
 class AdmissionDenied(RuntimeError):
     """Raised when a step would exceed the session's declared max_length."""
 

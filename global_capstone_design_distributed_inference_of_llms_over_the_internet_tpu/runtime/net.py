@@ -60,6 +60,7 @@ from ..telemetry import get_registry as _get_metrics_registry
 from ..telemetry import get_tracer
 from ..telemetry.profiling import get_profiler as _get_profiler
 from ..telemetry.profiling import stats_digest as _prof_digest
+from . import errors as _errors
 from .executor import StageExecutionError, StageExecutor
 from .faults import SITE_KINDS, FaultPlan, FaultSocket
 from .messages import BackwardRequest, StageRequest, StageResponse
@@ -90,8 +91,10 @@ PREALLOC_COMMIT = 128 * 1024 * 1024
 PREALLOC_AMP = 8
 
 
+@_errors.register
 class WireError(ConnectionError):
-    """Malformed or corrupted frame."""
+    """Malformed or corrupted frame (retryable via its ConnectionError
+    ancestor's catalog row: corruption fails closed and replays)."""
 
 
 # ---------------------------------------------------------------------------
@@ -2030,37 +2033,12 @@ class TcpTransport(Transport):
                 span=span,
             )
         if verb == "error":
-            if header.get("deadline_expired"):
-                # BEFORE the kind="stage" mapping: an exhausted deadline is
-                # terminal, and letting it surface as a retryable stage
-                # error would burn more of the caller's (already-blown)
-                # budget on failover attempts.
-                raise DeadlineExceeded(
-                    header.get("message",
-                               f"peer {peer_id}: deadline budget exhausted"))
-            if header.get("task_rejected"):
-                # BEFORE the kind="stage" mapping, for the same reason as
-                # deadline_expired: a permanently rejected task (oversized)
-                # can never succeed on a retry or replacement peer, so it
-                # must not enter the retryable failover taxonomy.
-                raise TaskRejected(
-                    header.get("message", f"peer {peer_id}: task rejected"),
-                    permanent=True)
-            if header.get("kind") == "push":
-                exc = PushChainError(header.get("peer", "?"),
-                                     header.get("message", "push failed"))
-                # Relay-aware blame split: `peer` is the hop to route
-                # around; `breaker_peer` (present only when they differ —
-                # e.g. a relay volunteer died, not the peer behind it) is
-                # the component whose circuit breaker should open.
-                exc.breaker_peer_id = header.get("breaker_peer")
-                raise exc
-            if header.get("kind") == "stage":
-                exc = StageExecutionError(header.get("message", "stage error"))
-                # Chain mode: the error may originate from a downstream hop.
-                exc.peer_id = header.get("peer")
-                raise exc
-            raise RuntimeError(f"peer {peer_id} error: {header.get('message')}")
+            # Wire markers -> typed exceptions via the ONE catalog
+            # (runtime/errors.py from_wire): terminal flags
+            # (deadline_expired, task_rejected) before the kind=
+            # discriminators they ride on, push frames carrying the
+            # relay-aware breaker_peer blame split.
+            raise _errors.from_wire(header, peer_id)
         raise WireError(f"unexpected response verb {verb!r}")
 
     def backward(self, peer_id: str, request: "BackwardRequest",
@@ -2119,9 +2097,11 @@ class TcpTransport(Transport):
                 grad_lora=grad_lora,
             )
         if header.get("verb") == "error":
-            if header.get("kind") == "stage":
-                raise StageExecutionError(header.get("message", "stage error"))
-            raise RuntimeError(f"peer {peer_id} error: {header.get('message')}")
+            # Same catalog mapping as the forward path: before this the
+            # backward parser dropped the task_rejected flag, so a PERMANENT
+            # rejection surfaced as a retryable StageExecutionError and the
+            # trainer burned its retry budget on oversized work.
+            raise _errors.from_wire(header, peer_id)
         raise WireError(f"unexpected response verb {header.get('verb')!r}")
 
     def end_session(self, peer_id: str, session_id: str) -> None:

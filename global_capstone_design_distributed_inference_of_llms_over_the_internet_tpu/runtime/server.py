@@ -243,7 +243,9 @@ class ElasticStageServer:
         # step shapes on every span (re)load before going ONLINE.
         self.advertise_address = advertise_address
         self.warmup = warmup
-        self._rng = rng or random.Random()
+        # Seeded default: an unseeded fallback makes rebalance jitter (and
+        # thus span layout) run-unique, breaking token-identical soak reruns.
+        self._rng = rng or random.Random(0)
         self._np_rng = np.random.default_rng(self._rng.randrange(2**31))
 
         # RTT probe to a peer; defaults to the transport's ping when the

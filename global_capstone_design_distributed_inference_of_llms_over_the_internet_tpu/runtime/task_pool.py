@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from ..telemetry import catalog as _tm
 from ..telemetry import events as _ev
+from .errors import register as _catalog
 
 logger = logging.getLogger(__name__)
 
@@ -45,6 +46,7 @@ KIND_BACKWARD = "backward"
 KINDS = (KIND_INFERENCE, KIND_FORWARD, KIND_BACKWARD)
 
 
+@_catalog
 class TaskRejected(RuntimeError):
     """The pool refused the task (oversized, or the runtime is stopped).
 

@@ -34,6 +34,7 @@ from ..telemetry import catalog as _tm
 from ..telemetry import events as _ev
 from ..telemetry import get_tracer
 from ..telemetry.profiling import get_profiler as _get_profiler
+from .errors import register as _catalog, retryable_types
 from .executor import StageExecutor
 from .messages import (
     BackwardRequest,
@@ -43,10 +44,12 @@ from .messages import (
 )
 
 
+@_catalog
 class PeerUnavailable(ConnectionError):
     """The peer is dead/unreachable (client must fail over)."""
 
 
+@_catalog
 class PushChainError(ConnectionError):
     """A DOWNSTREAM hop of a push chain failed. Carries the failing peer so
     the client blacklists the right server, not the chain's entry point."""
@@ -56,14 +59,15 @@ class PushChainError(ConnectionError):
         self.peer_id = peer_id
 
 
+@_catalog
 class DeadlineExceeded(RuntimeError):
     """The request's end-to-end deadline budget ran out (client-side before
     a hop was dialed, or a server rejected already-expired work).
 
     Deliberately NOT a TimeoutError/ConnectionError subclass: those are
-    RETRYABLE in the recovery taxonomy, and retrying an exhausted deadline
-    only burns more of the caller's (already-blown) budget. The recovery
-    wrapper re-raises this immediately."""
+    RETRYABLE in the recovery taxonomy (runtime/errors.py), and retrying an
+    exhausted deadline only burns more of the caller's (already-blown)
+    budget. The recovery wrapper re-raises this immediately."""
 
 
 class Transport(abc.ABC):
@@ -300,8 +304,7 @@ class LocalTransport(Transport):
                 return self.call(nxt["peer_id"], nreq, timeout)
             except PushChainError:
                 raise
-            except (PeerUnavailable, TimeoutError, ConnectionError,
-                    StageExecutionError) as exc:
+            except retryable_types() as exc:
                 raise PushChainError(nxt["peer_id"], str(exc)) from exc
         return resp
 

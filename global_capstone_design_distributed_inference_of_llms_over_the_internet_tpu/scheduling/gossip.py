@@ -102,7 +102,9 @@ class GossipNode:
         # selection (gossiping with yourself is a no-op round). Stamped by
         # the serve wiring once the listener is bound.
         self.self_address: Optional[str] = None
-        self._rng = rng or random.Random()
+        # Seeded default keeps peer-selection order reproducible when the
+        # caller does not inject an RNG (soaks pin token-identical reruns).
+        self._rng = rng or random.Random(0)
         self._lock = threading.Lock()
         self._entries: Dict[str, _Entry] = {}
         # The query mirror: discovery-shaped reads (list verb, peer

@@ -160,7 +160,9 @@ def should_choose_other_blocks(
         _ev.emit("rebalance_recommended", peer=local_peer_id,
                  quality=0.0, threshold=balance_quality)
         return True
-    rng = rng or np.random.default_rng()
+    # Seeded default: the re-span coin flip must be reproducible across
+    # soak reruns when the server wiring does not inject its own generator.
+    rng = rng or np.random.default_rng(0)
 
     spans = spans_from_records(records)
     th = compute_block_throughputs(spans, total_blocks)

@@ -139,7 +139,8 @@ class PlacementRegistry:
         self.ttl = ttl
         self._lock = threading.Lock()
         self._servers: Dict[str, ServerRecord] = {}
-        self._rng = rng or random.Random()
+        # Seeded default: choose_server tie-breaks must replay identically.
+        self._rng = rng or random.Random(0)
 
     # -- registration / heartbeat ------------------------------------------
 

@@ -28,6 +28,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ..runtime.errors import register as _catalog
 from ..telemetry import catalog as _tm
 from ..telemetry import events as _ev
 
@@ -37,6 +38,7 @@ from ..telemetry import events as _ev
 DEFAULT_RETRY_AFTER_S = 0.25
 
 
+@_catalog
 class Overloaded(RuntimeError):
     """Typed, NON-retryable admission refusal.
 

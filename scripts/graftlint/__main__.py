@@ -8,7 +8,13 @@ Exit status:
 
 ``--json`` emits a machine-readable report (new / suppressed / stale);
 ``--no-baseline`` shows everything the analyzers see, which is how you
-author baseline entries in the first place.
+author baseline entries in the first place. ``--sarif PATH`` additionally
+writes the NEW findings as SARIF 2.1.0 for code-review UIs.
+``--changed-only`` restricts reporting to files touched relative to a git
+ref (default HEAD) — the pre-push loop; the analyzers still parse the
+whole tree (interprocedural rules need it), only reporting is filtered,
+and the stale-entry check is disabled since a partial view can't see
+every key.
 """
 
 from __future__ import annotations
@@ -16,6 +22,7 @@ from __future__ import annotations
 import argparse
 import json
 import pathlib
+import subprocess
 import sys
 from typing import List, Optional
 
@@ -23,16 +30,72 @@ from .core import (ALL_ANALYZERS, BASELINE_FILE, Baseline, BaselineError,
                    build_context, run_analyzers)
 
 
+def _changed_files(repo: pathlib.Path, ref: str) -> Optional[set]:
+    """Repo-relative posix paths changed vs ``ref`` (committed + staged +
+    worktree). None on git failure — the caller falls back to full-tree
+    reporting rather than silently reporting nothing."""
+    try:
+        proc = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--"],
+            cwd=repo, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    return {line.strip() for line in proc.stdout.splitlines()
+            if line.strip()}
+
+
+def _sarif_report(new) -> dict:
+    """SARIF 2.1.0: one run, one rule entry per distinct graftlint rule,
+    one result per NEW finding (baselined findings are suppressed by
+    design and stay out of review UIs)."""
+    rules = sorted({f.rule for f in new})
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri": "docs/STATIC_ANALYSIS.md",
+                "rules": [{"id": r} for r in rules],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "partialFingerprints": {"graftlintKey": f.key},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": f.line},
+                    },
+                }],
+            } for f in new],
+        }],
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m scripts.graftlint",
         description="repo-native static analysis: lock discipline, JAX "
-                    "hygiene, dispatch/doc drift")
+                    "hygiene, failure-flow retry safety, determinism "
+                    "taint, dispatch/doc drift")
     ap.add_argument("--analyzer", action="append", metavar="NAME",
                     help="run only this analyzer (repeatable); choices: "
                          + ", ".join(ALL_ANALYZERS))
     ap.add_argument("--json", action="store_true",
                     help="emit a JSON report instead of text")
+    ap.add_argument("--sarif", metavar="PATH",
+                    help="also write new findings as SARIF 2.1.0 to PATH "
+                         "('-' for stdout)")
+    ap.add_argument("--changed-only", nargs="?", const="HEAD",
+                    metavar="REF", default=None,
+                    help="report only findings in files changed vs REF "
+                         "(default HEAD); analyzers still see the whole "
+                         "tree, and the stale-entry check is skipped")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore graftlint_baseline.json; report everything")
     ap.add_argument("--show-baselined", action="store_true",
@@ -49,6 +112,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"error: {exc.args[0]}", file=sys.stderr)
         return 2
 
+    changed: Optional[set] = None
+    if args.changed_only is not None:
+        changed = _changed_files(args.repo, args.changed_only)
+        if changed is None:
+            print("warning: git diff failed; reporting the full tree",
+                  file=sys.stderr)
+        else:
+            findings = [f for f in findings if f.path in changed]
+
     if args.no_baseline:
         baseline = Baseline({})
     else:
@@ -60,8 +132,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     new, suppressed, stale = baseline.split(findings)
 
     # Stale entries only mean something when the full suite ran against
-    # the real baseline — a partial --analyzer run can't see every key.
-    check_stale = not args.no_baseline and not args.analyzer
+    # the real baseline over the whole tree — a partial --analyzer or
+    # --changed-only run can't see every key.
+    check_stale = (not args.no_baseline and not args.analyzer
+                   and changed is None)
+
+    if args.sarif:
+        sarif = json.dumps(_sarif_report(new), indent=2)
+        if args.sarif == "-":
+            print(sarif)
+        else:
+            pathlib.Path(args.sarif).write_text(sarif + "\n",
+                                                encoding="utf-8")
 
     if args.json:
         print(json.dumps({
@@ -86,9 +168,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             for k in stale:
                 print(f"  {k}")
         if not new and not (check_stale and stale):
+            scope = (f"{len(changed)} changed file(s)"
+                     if changed is not None else "full tree")
             print(f"ok: graftlint clean "
                   f"({len(findings)} finding(s), {len(suppressed)} "
-                  f"baselined, analyzers: "
+                  f"baselined, {scope}, analyzers: "
                   f"{', '.join(args.analyzer or ALL_ANALYZERS)})")
     if new or (check_stale and stale):
         return 1

@@ -151,13 +151,16 @@ class Baseline:
 def _registry() -> Dict[str, Callable[[Context], List[Finding]]]:
     # Imported lazily so `import scripts.graftlint` stays cheap and a bug
     # in one analyzer module doesn't break the others' entry points.
-    from . import dispatch, env_flags, jax_hygiene, legacy, locks
+    from . import (determinism, dispatch, env_flags, failures, jax_hygiene,
+                   legacy, locks)
 
     return {
         "locks": locks.analyze,
         "jax": jax_hygiene.analyze,
         "dispatch": dispatch.analyze,
         "env_flags": env_flags.analyze,
+        "failures": failures.analyze,
+        "determinism": determinism.analyze,
         "bare_print": legacy.analyze_bare_print,
         "metrics_doc": legacy.analyze_metrics_doc,
         "cli_doc": legacy.analyze_cli_doc,
@@ -166,7 +169,7 @@ def _registry() -> Dict[str, Callable[[Context], List[Finding]]]:
 
 
 ALL_ANALYZERS: Tuple[str, ...] = (
-    "locks", "jax", "dispatch", "env_flags",
+    "locks", "jax", "dispatch", "env_flags", "failures", "determinism",
     "bare_print", "metrics_doc", "cli_doc", "quant_coverage",
 )
 

@@ -1,0 +1,380 @@
+"""Determinism taint checking of the token path (graftlint phase 2,
+family 2).
+
+Every chaos/relay/overload soak demands TOKEN-IDENTICAL output across a
+clean run and a faulted run. That invariant dies the moment wall-clock,
+process-unique, or iteration-order nondeterminism leaks into anything
+that picks a token: sampling parameters, PRNGKey seed derivation, journal
+and digest inputs, decode-path wire frame fields.
+
+Rules:
+
+- ``det-unseeded-rng`` — ``random.Random()`` / ``np.random.default_rng()``
+  constructed with no seed. Every RNG in this codebase is injectable and
+  seeded (the soaks depend on it); an unseeded fallback is a latent
+  nondeterminism bomb that only fires when a caller forgets to inject.
+- ``det-taint`` — intraprocedural forward taint from nondeterminism
+  sources (``time.time``/``monotonic``/``perf_counter`` families,
+  ``os.urandom``, ``uuid``, module-level ``random.*`` draws, builtin
+  ``hash()``, iteration over a ``set``) into token-affecting sinks:
+  ``seed=``/``step_seed=``/``session_id=`` keyword arguments, the seed
+  argument of ``PRNGKey``/``fold_in``, hashlib digest construction and
+  ``.update()`` on a digest object, ``SamplingParams(...)`` and journal
+  entry arguments, and the return value of a function whose name says it
+  produces a seed/session/digest.
+- ``det-key-reuse`` — PRNGKey discipline: a key consumed by two
+  ``jax.random.*`` draws without an intervening ``split``/``fold_in``
+  rebinding, or a draw inside a loop/comprehension from a key that the
+  loop never rebinds. The sanctioned idioms — ``PRNGKey(seed + i)``
+  bursts and ``fold_in(base, i)`` — construct the key inline or derive
+  per-index and never trip this.
+
+Deliberately out of scope (documented in docs/STATIC_ANALYSIS.md): taint
+across function boundaries (a tainted value passed as an argument is the
+callee's parameter, judged clean there), dict iteration (insertion-
+ordered since 3.7), and keys smuggled through containers or non-jax
+helper calls. The analyzer is lexical per function — cheap and quiet, in
+exchange for catching only same-function flows; the fixture proves each
+rule fires and the soaks still backstop the rest dynamically.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from . import astutil
+from .core import Context, Finding
+
+CLOCK_CALLS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+}
+UUID_CALLS = {"uuid.uuid1", "uuid.uuid4", "os.urandom"}
+# Module-level draws from the GLOBAL (unseeded, process-shared) random
+# module. Instance draws (self._rng.choice) are fine: instances are
+# seeded/injected, which det-unseeded-rng separately enforces.
+GLOBAL_RANDOM = re.compile(
+    r"^(random|np\.random|numpy\.random)\."
+    r"(random|randint|randrange|getrandbits|choice|choices|shuffle|sample|"
+    r"uniform|gauss|normal|permutation|rand|randn)$")
+
+SEED_KWARGS = {"seed", "step_seed", "seed_base", "seeds", "session_id"}
+SEEDY_NAME = re.compile(r"seed|session_id|digest")
+HASHLIB_CTORS = {"blake2b", "blake2s", "sha256", "sha1", "md5"}
+JOURNAL_SINKS = {"journal_append", "_journal_append", "JournalEntry",
+                 "SamplingParams"}
+
+KEY_PARAM = re.compile(r"^(rng|key|prng(_key)?|.*_key|key_.*)$")
+KEY_MAKERS = {"PRNGKey", "split", "fold_in"}
+KEY_CONSUMERS = {
+    "categorical", "uniform", "normal", "bernoulli", "gumbel", "randint",
+    "truncated_normal", "permutation", "choice", "exponential", "laplace",
+    "bits", "beta", "gamma", "dirichlet", "poisson", "ball", "cauchy",
+    "exponential", "loggamma", "multivariate_normal", "rademacher",
+}
+
+
+def _source_label(call: ast.Call) -> Optional[str]:
+    dn = astutil.dotted_name(call.func)
+    if dn is None:
+        return None
+    if dn in CLOCK_CALLS:
+        return "clock"
+    if dn in UUID_CALLS:
+        return "uuid" if "uuid" in dn else "urandom"
+    if GLOBAL_RANDOM.match(dn):
+        return "global-random"
+    if dn == "hash" and call.args:
+        return "hash"
+    return None
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Set):
+        return True
+    return (isinstance(node, ast.Call)
+            and astutil.dotted_name(node.func) == "set")
+
+
+class _FuncScan:
+    """One function's taint + key-discipline pass, in statement order.
+
+    Loop bodies are processed twice so loop-carried taint and the
+    "key consumed every iteration but never rebound" hazard both
+    surface on the second pass."""
+
+    def __init__(self, mod: astutil.Module, qual: str, fn: ast.AST,
+                 findings: List[Finding]):
+        self.mod = mod
+        self.qual = qual
+        self.fn = fn
+        self.findings = findings
+        self.taint: Dict[str, str] = {}       # name -> source label
+        self.digest_vars: Set[str] = set()    # names bound to hashlib objs
+        self.key_fresh: Dict[str, bool] = {}  # key name -> unconsumed?
+        self.reported: Set[str] = set()
+        self.seedy_return = bool(
+            SEEDY_NAME.search(qual.split(".")[-1].lower()))
+        for a in getattr(fn, "args", None) and (
+                fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+        ) or ():
+            if KEY_PARAM.match(a.arg) and a.arg != "self":
+                self.key_fresh[a.arg] = True
+
+    # -- reporting -----------------------------------------------------
+
+    def _emit(self, rule: str, line: int, anchor: str, msg: str) -> None:
+        if anchor in self.reported:
+            return
+        self.reported.add(anchor)
+        self.findings.append(Finding(
+            rule=rule, path=self.mod.rel, line=line, anchor=anchor,
+            message=msg))
+
+    # -- expression taint ----------------------------------------------
+
+    def expr_taint(self, node: Optional[ast.AST]) -> Optional[str]:
+        if node is None:
+            return None
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                label = _source_label(sub)
+                if label is not None:
+                    return label
+            elif isinstance(sub, ast.Name) and sub.id in self.taint:
+                return self.taint[sub.id]
+        return None
+
+    # -- sinks ---------------------------------------------------------
+
+    def _check_call_sinks(self, call: ast.Call) -> None:
+        term = astutil.terminal_attr(call)
+        dn = astutil.dotted_name(call.func) or term or ""
+        for kw in call.keywords:
+            if kw.arg in SEED_KWARGS:
+                label = self.expr_taint(kw.value)
+                if label is not None:
+                    self._emit(
+                        "det-taint", call.lineno,
+                        f"{self.qual}:{kw.arg}",
+                        f"{self.qual}: {label}-tainted value reaches "
+                        f"token-affecting sink {kw.arg}= — soak reruns "
+                        "would diverge")
+        if term == "PRNGKey" and call.args:
+            label = self.expr_taint(call.args[0])
+            if label is not None:
+                self._emit("det-taint", call.lineno,
+                           f"{self.qual}:PRNGKey",
+                           f"{self.qual}: {label}-tainted seed feeds "
+                           "PRNGKey — the token stream becomes "
+                           "run-unique")
+        if term == "fold_in" and len(call.args) > 1:
+            label = self.expr_taint(call.args[1])
+            if label is not None:
+                self._emit("det-taint", call.lineno,
+                           f"{self.qual}:fold_in",
+                           f"{self.qual}: {label}-tainted data folded "
+                           "into a PRNG key")
+        if term in HASHLIB_CTORS or term in JOURNAL_SINKS:
+            for arg in list(call.args) + [k.value for k in call.keywords]:
+                label = self.expr_taint(arg)
+                if label is not None:
+                    self._emit("det-taint", call.lineno,
+                               f"{self.qual}:{term}",
+                               f"{self.qual}: {label}-tainted value enters "
+                               f"{term} — journal/digest inputs must be "
+                               "replay-stable")
+                    break
+        if (term == "update" and isinstance(call.func, ast.Attribute)
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id in self.digest_vars):
+            for arg in call.args:
+                label = self.expr_taint(arg)
+                if label is not None:
+                    self._emit("det-taint", call.lineno,
+                               f"{self.qual}:{call.func.value.id}.update",
+                               f"{self.qual}: {label}-tainted bytes enter "
+                               "a digest — replay verification would "
+                               "mismatch")
+                    break
+        # Unseeded RNG constructions (a rule of their own).
+        if dn in ("random.Random",) and not call.args and not call.keywords:
+            self._emit("det-unseeded-rng", call.lineno,
+                       f"{self.qual}:random.Random",
+                       f"{self.qual}: random.Random() with no seed — "
+                       "inject or default a seeded RNG (the soaks pin "
+                       "token-identical reruns)")
+        if (dn in ("np.random.default_rng", "numpy.random.default_rng")
+                and not call.args and not call.keywords):
+            self._emit("det-unseeded-rng", call.lineno,
+                       f"{self.qual}:default_rng",
+                       f"{self.qual}: default_rng() with no seed — "
+                       "inject or default a seeded generator")
+
+    def _check_key_consumer(self, call: ast.Call, in_loop: bool) -> None:
+        dn = astutil.dotted_name(call.func) or ""
+        if not dn.startswith("jax.random."):
+            return
+        term = dn.rsplit(".", 1)[-1]
+        if term not in KEY_CONSUMERS or not call.args:
+            return
+        arg0 = call.args[0]
+        if not isinstance(arg0, ast.Name):
+            return  # inline PRNGKey(seed + i) — the sanctioned burst idiom
+        name = arg0.id
+        if name not in self.key_fresh:
+            return
+        if not self.key_fresh[name]:
+            self._emit("det-key-reuse", call.lineno,
+                       f"{self.qual}:{name}",
+                       f"{self.qual}: key {name!r} consumed by "
+                       f"jax.random.{term} twice with no intervening "
+                       "split/fold_in — identical draws, correlated "
+                       "samples")
+        self.key_fresh[name] = False
+        del in_loop
+
+    # -- statement walk ------------------------------------------------
+
+    def _scan_calls(self, stmt: ast.AST, in_loop: bool) -> None:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                self._check_call_sinks(node)
+                self._check_key_consumer(node, in_loop)
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                # A comprehension is a loop: a named key consumed inside it
+                # is consumed once per element.
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        dn = astutil.dotted_name(sub.func) or ""
+                        term = dn.rsplit(".", 1)[-1]
+                        if (dn.startswith("jax.random.")
+                                and term in KEY_CONSUMERS and sub.args
+                                and isinstance(sub.args[0], ast.Name)
+                                and sub.args[0].id in self.key_fresh):
+                            self._emit(
+                                "det-key-reuse", sub.lineno,
+                                f"{self.qual}:{sub.args[0].id}",
+                                f"{self.qual}: key "
+                                f"{sub.args[0].id!r} consumed inside a "
+                                "comprehension without per-element "
+                                "split/fold_in")
+
+    def _assign_names(self, target: ast.AST) -> List[str]:
+        if isinstance(target, ast.Name):
+            return [target.id]
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out = []
+            for elt in target.elts:
+                out.extend(self._assign_names(elt))
+            return out
+        return []
+
+    def _handle_assign(self, names: List[str], value: ast.AST) -> None:
+        label = self.expr_taint(value)
+        is_key_src = (isinstance(value, ast.Call)
+                      and astutil.terminal_attr(value) in KEY_MAKERS)
+        is_digest = (isinstance(value, ast.Call)
+                     and astutil.terminal_attr(value) in HASHLIB_CTORS)
+        for n in names:
+            if label is not None:
+                self.taint[n] = label
+            else:
+                self.taint.pop(n, None)
+            if is_key_src:
+                self.key_fresh[n] = True
+            else:
+                self.key_fresh.pop(n, None)
+            if is_digest:
+                self.digest_vars.add(n)
+            else:
+                self.digest_vars.discard(n)
+
+    def run_block(self, body, in_loop: bool = False) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested defs get their own scan
+            if isinstance(stmt, ast.Assign):
+                self._scan_calls(stmt.value, in_loop)
+                names = []
+                for t in stmt.targets:
+                    names.extend(self._assign_names(t))
+                self._handle_assign(names, stmt.value)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                self._scan_calls(stmt.value, in_loop)
+                self._handle_assign(self._assign_names(stmt.target),
+                                    stmt.value)
+            elif isinstance(stmt, ast.AugAssign):
+                self._scan_calls(stmt.value, in_loop)
+                label = self.expr_taint(stmt.value)
+                for n in self._assign_names(stmt.target):
+                    if label is not None:
+                        self.taint[n] = label
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_calls(stmt.iter, in_loop)
+                label = self.expr_taint(stmt.iter)
+                if _is_set_expr(stmt.iter):
+                    label = label or "set-iteration"
+                for n in self._assign_names(stmt.target):
+                    if label is not None:
+                        self.taint[n] = label
+                    else:
+                        self.taint.pop(n, None)
+                # Twice: loop-carried taint + unrebound-key detection.
+                self.run_block(stmt.body, in_loop=True)
+                self.run_block(stmt.body, in_loop=True)
+                self.run_block(stmt.orelse, in_loop)
+            elif isinstance(stmt, ast.While):
+                self._scan_calls(stmt.test, True)
+                self.run_block(stmt.body, in_loop=True)
+                self.run_block(stmt.body, in_loop=True)
+                self.run_block(stmt.orelse, in_loop)
+            elif isinstance(stmt, ast.If):
+                self._scan_calls(stmt.test, in_loop)
+                self.run_block(stmt.body, in_loop)
+                self.run_block(stmt.orelse, in_loop)
+            elif isinstance(stmt, ast.Try):
+                self.run_block(stmt.body, in_loop)
+                for h in stmt.handlers:
+                    self.run_block(h.body, in_loop)
+                self.run_block(stmt.orelse, in_loop)
+                self.run_block(stmt.finalbody, in_loop)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._scan_calls(item.context_expr, in_loop)
+                self.run_block(stmt.body, in_loop)
+            elif isinstance(stmt, ast.Return):
+                if stmt.value is not None:
+                    self._scan_calls(stmt.value, in_loop)
+                    if self.seedy_return:
+                        label = self.expr_taint(stmt.value)
+                        if label is not None:
+                            self._emit(
+                                "det-taint", stmt.lineno,
+                                f"{self.qual}:return",
+                                f"{self.qual}: returns a {label}-tainted "
+                                "value from a seed/session/digest "
+                                "factory — every caller inherits the "
+                                "nondeterminism")
+            else:
+                self._scan_calls(stmt, in_loop)
+
+
+def analyze(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        for qual, _cls, fn in astutil.walk_functions(mod.tree):
+            scan = _FuncScan(mod, qual, fn, findings)
+            scan.run_block(fn.body)
+        # Module top level (constants computed at import): unseeded RNGs
+        # and clock-derived module state are findings there too.
+        top = _FuncScan(mod, "<module>", ast.parse(""), findings)
+        top.run_block([s for s in mod.tree.body
+                       if not isinstance(s, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef,
+                                             ast.ClassDef))])
+    return findings

@@ -1,0 +1,562 @@
+"""Failure-flow retry-safety analysis (graftlint phase 2, family 1).
+
+The failover guarantee — clients transparently fail over and replay —
+rests on a taxonomy: which exceptions are retryable, which are terminal,
+and which component gets blamed. ``runtime/errors.py`` is now that
+taxonomy's single source of truth; this analyzer statically checks the
+failure plane (``runtime/``, ``serving/``, ``scheduling/``) against it.
+
+Rules:
+
+- ``exc-uncatalogued`` — a public exception class defined in the failure
+  plane whose policy reaches the recovery wrapper through no catalogued
+  ancestor. Private classes (``_BreakerOpen``, ``_HopFailed``) are
+  internal control flow and exempt; subclasses of catalogued classes
+  (``WireError`` under ``ConnectionError``) inherit their row.
+- ``exc-unregistered`` — a class that HAS a catalog row but whose
+  definition site lacks the ``@register`` decorator, so the runtime
+  registry and the static table can drift apart.
+- ``exc-swallowed`` — a broad ``except Exception``/``BaseException``/
+  ``OSError`` (or bare ``except``) handler in recovery-reachable code
+  that neither re-raises nor constructs a catalogued type: the failure
+  disappears instead of driving failover. Cleanup ``try`` blocks (close/
+  shutdown/cancel-only bodies) are exempt — swallowing there is the
+  idiom, not a bug.
+- ``exc-side-effect-before-raise`` — a journal append or KV/prefix-cache
+  mutation lexically before a raise of a retryable type in the same
+  recovery-reachable function: on replay the side effect happens twice.
+- ``wire-error-blame`` — a ``kind="push"`` error-frame literal built
+  without deciding ``breaker_peer`` blame (neither a key in the literal
+  nor an ``err["breaker_peer"] = ...`` in the enclosing function). Sites
+  where breaker blame deliberately coincides with routing blame are
+  baselined with that argument in writing.
+- ``taxonomy-undocumented`` / ``taxonomy-unknown`` — drift between the
+  catalog and docs/FAULT_TOLERANCE.md's taxonomy table, both directions
+  (a row per class with its policy; a mismatched policy counts as
+  undocumented).
+
+Precision notes: reachability is the same name-based BFS the jax
+analyzer uses — ``self.m()`` resolves within the class, bare names within
+the module, and a generic ``obj.m()`` only when exactly ONE failure-plane
+class defines ``m`` (the lock analyzer's unique-target discipline).
+Everything here parses ASTs; the errors module is never imported.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import astutil
+from .core import Context, Finding
+
+PLANE_DIRS = ("runtime", "serving", "scheduling")
+
+BUILTIN_EXC = {
+    "BaseException", "Exception", "ArithmeticError", "AssertionError",
+    "AttributeError", "ConnectionError", "EOFError", "IOError",
+    "KeyError", "LookupError", "MemoryError", "NotImplementedError",
+    "OSError", "RuntimeError", "StopIteration", "TimeoutError",
+    "TypeError", "ValueError",
+}
+
+BROAD_HANDLERS = {"Exception", "BaseException", "OSError"}
+
+# A try body made only of these calls is teardown; swallowing its errors
+# is the idiom (a close() racing a dead socket must not crash recovery).
+CLEANUP_CALLS = {
+    "close", "shutdown", "unlink", "cancel", "join", "kill", "terminate",
+    "remove", "rmtree", "release", "stop", "disconnect", "detach", "pop",
+    "clear", "settimeout",
+}
+
+# Side-effecting mutations that must not precede a retryable raise:
+# receiver-name tokens x mutator terminals.
+_JOURNAL_TERMINALS = {"journal_append", "_journal_append"}
+_MUTATORS = {"append", "appendleft", "add", "put", "setdefault", "insert",
+             "store", "extend", "allocate", "write", "push"}
+_STATE_TOKENS = {"journal", "cache", "store", "prefix", "arena"}
+
+
+# ---------------------------------------------------------------------------
+# Taxonomy: parse runtime/errors.py without importing it
+# ---------------------------------------------------------------------------
+
+def _parse_taxonomy(mod: astutil.Module) -> Dict[str, Tuple[str, str]]:
+    """ErrorPolicy rows -> {name: (policy, scope)}. Resolves the policy
+    constants (RETRYABLE = "retryable") from module-level assignments."""
+    consts: Dict[str, str] = {}
+    for node in mod.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            v = astutil.str_const(node.value)
+            if v is not None:
+                consts[node.targets[0].id] = v
+
+    def resolve(node: Optional[ast.AST]) -> Optional[str]:
+        if node is None:
+            return None
+        s = astutil.str_const(node)
+        if s is not None:
+            return s
+        name = astutil.dotted_name(node)
+        if name is not None:
+            return consts.get(name.split(".")[-1])
+        return None
+
+    entries: Dict[str, Tuple[str, str]] = {}
+    for call in ast.walk(mod.tree):
+        if (isinstance(call, ast.Call)
+                and astutil.terminal_attr(call) == "ErrorPolicy"):
+            kw = {k.arg: k.value for k in call.keywords}
+            name = astutil.str_const(
+                kw.get("name", call.args[0] if call.args else None))
+            policy = resolve(
+                kw.get("policy",
+                       call.args[1] if len(call.args) > 1 else None))
+            scope = resolve(kw.get("scope")) or "client"
+            if name and policy:
+                entries[name] = (policy, scope)
+    return entries
+
+
+def _taxonomy_module(ctx: Context) -> Optional[astutil.Module]:
+    for m in ctx.modules:
+        if m.rel.endswith("/errors.py") or m.rel == "errors.py":
+            return m
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Failure-plane scope + class census
+# ---------------------------------------------------------------------------
+
+def _scope_modules(ctx: Context) -> List[astutil.Module]:
+    scoped = [m for m in ctx.modules
+              if set(m.rel.split("/")) & set(PLANE_DIRS)]
+    # Fixture packages have no runtime/serving/scheduling layout; the
+    # whole fixture tree is the failure plane.
+    return scoped or list(ctx.modules)
+
+
+def _class_census(mods: List[astutil.Module]
+                  ) -> Dict[str, Tuple[astutil.Module, ast.ClassDef,
+                                       List[str]]]:
+    """name -> (module, node, base names). Last definition wins on a
+    (rare, and lint-worthy elsewhere) name collision."""
+    out = {}
+    for mod in mods:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                bases = []
+                for b in node.bases:
+                    dn = astutil.dotted_name(b)
+                    if dn:
+                        bases.append(dn.split(".")[-1])
+                out[node.name] = (mod, node, bases)
+    return out
+
+
+def _exceptionish(census) -> Set[str]:
+    """Names whose base chain reaches a builtin exception (fixpoint over
+    the package class graph — no imports, names only)."""
+    known: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, (_, _, bases) in census.items():
+            if name in known:
+                continue
+            if any(b in BUILTIN_EXC or b in known for b in bases):
+                known.add(name)
+                changed = True
+    return known
+
+
+def _covered(name: str, taxonomy: Dict[str, Tuple[str, str]],
+             census) -> bool:
+    """Catalogued directly or via any ancestor (package chain + builtin
+    bases — ConnectionError/TimeoutError rows cover their subclasses)."""
+    seen: Set[str] = set()
+    stack = [name]
+    while stack:
+        n = stack.pop()
+        if n in taxonomy:
+            return True
+        if n in seen:
+            continue
+        seen.add(n)
+        if n in census:
+            stack.extend(census[n][2])
+    return False
+
+
+def _has_register_decorator(node: ast.ClassDef) -> bool:
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = astutil.dotted_name(target) or ""
+        if "register" in name or "catalog" in name:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Recovery reachability (name-based BFS, unique-target discipline)
+# ---------------------------------------------------------------------------
+
+_ROOT_NAMES = {"_call_with_recovery", "_walk_chain_traced", "_replay",
+               "_replay_chain"}
+_ROOT_PREFIXES = ("_dispatch", "_handle", "_relay_forward", "_serve")
+
+
+def _is_root(qual: str, cls: Optional[str]) -> bool:
+    name = qual.split(".")[-1]
+    if name in _ROOT_NAMES:
+        return True
+    if name.startswith(_ROOT_PREFIXES):
+        return True
+    # Transport entry points: the retried region's dynamic extent.
+    if cls and "Transport" in cls and name in {"call", "backward"}:
+        return True
+    return False
+
+
+class _Reach:
+    """Recovery-reachable function set over the failure plane."""
+
+    def __init__(self, mods: List[astutil.Module]):
+        # (rel, qual) -> (funcdef, class_name)
+        self.funcs: Dict[Tuple[str, str], Tuple[ast.AST, Optional[str]]] = {}
+        # bare function name -> [(rel, qual)] (module-level defs only)
+        self.module_level: Dict[str, List[Tuple[str, str]]] = {}
+        # method name -> [(rel, qual, class)]
+        self.methods: Dict[str, List[Tuple[str, str, str]]] = {}
+        for mod in mods:
+            for qual, cls, fn in astutil.walk_functions(mod.tree):
+                self.funcs[(mod.rel, qual)] = (fn, cls)
+                name = qual.split(".")[-1]
+                if cls is None and "." not in qual:
+                    self.module_level.setdefault(name, []).append(
+                        (mod.rel, qual))
+                elif cls is not None and qual == f"{cls}.{name}":
+                    self.methods.setdefault(name, []).append(
+                        (mod.rel, qual, cls))
+        self.reachable = self._bfs()
+
+    def _edges(self, rel: str, fn: ast.AST,
+               cls: Optional[str]) -> List[Tuple[str, str]]:
+        out = []
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            if isinstance(f, ast.Name):
+                cands = [c for c in self.module_level.get(f.id, ())
+                         if c[0] == rel]
+                cands = cands or self.module_level.get(f.id, [])
+                if len(cands) == 1:
+                    out.append(cands[0])
+            elif isinstance(f, ast.Attribute):
+                owners = self.methods.get(f.attr, [])
+                if (isinstance(f.value, ast.Name) and f.value.id == "self"
+                        and cls is not None):
+                    same = [o[:2] for o in owners if o[2] == cls]
+                    if len(same) == 1:
+                        out.append(same[0])
+                    continue
+                # Generic receiver: resolve only on a unique target —
+                # common method names would weave phantom reachability.
+                if len(owners) == 1:
+                    out.append(owners[0][:2])
+        return out
+
+    def _bfs(self) -> Set[Tuple[str, str]]:
+        queue = [key for key, (_, cls) in self.funcs.items()
+                 if _is_root(key[1], cls)]
+        seen = set(queue)
+        while queue:
+            rel, qual = queue.pop()
+            fn, cls = self.funcs[(rel, qual)]
+            for nxt in self._edges(rel, fn, cls):
+                if nxt not in seen and nxt in self.funcs:
+                    seen.add(nxt)
+                    queue.append(nxt)
+        return seen
+
+
+# ---------------------------------------------------------------------------
+# Rule bodies
+# ---------------------------------------------------------------------------
+
+def _check_catalog(mods, taxonomy, findings: List[Finding]) -> None:
+    census = _class_census(mods)
+    excish = _exceptionish(census)
+    for name in sorted(excish):
+        mod, node, _bases = census[name]
+        if name.startswith("_"):
+            continue  # private: internal control flow, never crosses a seam
+        if not _covered(name, taxonomy, census):
+            findings.append(Finding(
+                rule="exc-uncatalogued", path=mod.rel, line=node.lineno,
+                anchor=name,
+                message=f"exception {name} can surface through the failure "
+                        "plane but has no runtime/errors.py TAXONOMY row "
+                        "(and no catalogued ancestor) — recovery cannot "
+                        "classify it"))
+        elif name in taxonomy and not _has_register_decorator(node):
+            findings.append(Finding(
+                rule="exc-unregistered", path=mod.rel, line=node.lineno,
+                anchor=name,
+                message=f"{name} has a TAXONOMY row but its definition "
+                        "lacks @register — the runtime registry and the "
+                        "static catalog can drift"))
+
+
+def _handler_names(handler: ast.ExceptHandler) -> List[str]:
+    t = handler.type
+    if t is None:
+        return ["Exception"]  # bare except
+    nodes = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = []
+    for n in nodes:
+        dn = astutil.dotted_name(n)
+        if dn:
+            out.append(dn.split(".")[-1])
+    return out
+
+
+def _is_cleanup_try(try_node: ast.Try) -> bool:
+    for stmt in try_node.body:
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            if astutil.terminal_attr(stmt.value) in CLEANUP_CALLS:
+                continue
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            if astutil.terminal_attr(stmt.value) in CLEANUP_CALLS:
+                continue
+        if isinstance(stmt, ast.Pass):
+            continue
+        return False
+    return bool(try_node.body)
+
+
+def _converts_or_reraises(handler: ast.ExceptHandler,
+                          catalogued: Set[str]) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            t = astutil.terminal_attr(node)
+            if t in catalogued:
+                return True  # converted even if returned/recorded
+    return False
+
+
+def _first_try_call(try_node: ast.Try) -> str:
+    for node in ast.walk(ast.Module(body=try_node.body, type_ignores=[])):
+        if isinstance(node, ast.Call):
+            return astutil.terminal_attr(node) or "block"
+    return "block"
+
+
+def _check_swallowed(mods, reach: _Reach, catalogued: Set[str],
+                     findings: List[Finding]) -> None:
+    for mod in mods:
+        for qual, _cls, fn in astutil.walk_functions(mod.tree):
+            if (mod.rel, qual) not in reach.reachable:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Try):
+                    continue
+                if _is_cleanup_try(node):
+                    continue
+                for handler in node.handlers:
+                    broad = [n for n in _handler_names(handler)
+                             if n in BROAD_HANDLERS]
+                    if not broad:
+                        continue
+                    if _converts_or_reraises(handler, catalogued):
+                        continue
+                    anchor = (f"{qual}:except-{broad[0]}"
+                              f"@{_first_try_call(node)}")
+                    findings.append(Finding(
+                        rule="exc-swallowed", path=mod.rel,
+                        line=handler.lineno, anchor=anchor,
+                        message=f"{qual}: broad except {broad[0]} in "
+                                "recovery-reachable code neither re-raises "
+                                "nor converts to a catalogued type — the "
+                                "failure vanishes instead of driving "
+                                "failover"))
+
+
+def _side_effect_kind(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        dn = astutil.dotted_name(node.func)
+        if not dn:
+            return None
+        parts = dn.split(".")
+        term, recv = parts[-1], parts[:-1]
+        if term in _JOURNAL_TERMINALS:
+            return term
+        if term in _MUTATORS and any(
+                tok in seg for seg in recv for tok in _STATE_TOKENS):
+            return f"{parts[-2]}.{term}" if len(parts) > 1 else term
+    if isinstance(node, ast.AugAssign):
+        target = astutil.dotted_name(node.target)
+        if target and any(tok in target for tok in ("journal", "_seq")):
+            return target
+    return None
+
+
+def _check_side_effects(mods, reach: _Reach, retryable: Set[str],
+                        findings: List[Finding]) -> None:
+    for mod in mods:
+        for qual, _cls, fn in astutil.walk_functions(mod.tree):
+            if (mod.rel, qual) not in reach.reachable:
+                continue
+            effects = []  # (line, label)
+            raises = []   # (line, exc name)
+            for node in ast.walk(fn):
+                kind = _side_effect_kind(node)
+                if kind is not None:
+                    effects.append((node.lineno, kind))
+                if isinstance(node, ast.Raise) and node.exc is not None:
+                    exc = node.exc
+                    target = exc.func if isinstance(exc, ast.Call) else exc
+                    dn = astutil.dotted_name(target)
+                    if dn and dn.split(".")[-1] in retryable:
+                        raises.append((node.lineno, dn.split(".")[-1]))
+            for line, label in effects:
+                hit = [r for r in raises if r[0] > line]
+                if hit:
+                    findings.append(Finding(
+                        rule="exc-side-effect-before-raise", path=mod.rel,
+                        line=line, anchor=f"{qual}:{label}",
+                        message=f"{qual}: {label} mutates journaled/cached "
+                                f"state before raising retryable "
+                                f"{hit[0][1]} — the replayed attempt "
+                                "repeats the side effect"))
+
+
+def _msg_slug(d: ast.Dict) -> str:
+    for k, v in zip(d.keys, d.values):
+        if k is not None and astutil.str_const(k) == "message":
+            txt = astutil.str_const(v) or ""
+            if isinstance(v, ast.JoinedStr):
+                for part in v.values:
+                    if isinstance(part, ast.Constant):
+                        txt = str(part.value)
+                        break
+            words = re.findall(r"[a-z]+", txt.lower())[:3]
+            if words:
+                return "-".join(words)
+    return "push"
+
+
+def _dict_str_items(d: ast.Dict) -> Dict[str, ast.AST]:
+    out = {}
+    for k, v in zip(d.keys, d.values):
+        if k is not None:
+            s = astutil.str_const(k)
+            if s is not None:
+                out[s] = v
+    return out
+
+
+def _check_wire_blame(mods, findings: List[Finding]) -> None:
+    for mod in mods:
+        for qual, _cls, fn in astutil.walk_functions(mod.tree):
+            assigns_blame = any(
+                isinstance(n, ast.Assign)
+                and any(isinstance(t, ast.Subscript)
+                        and astutil.str_const(t.slice) == "breaker_peer"
+                        for t in n.targets)
+                for n in ast.walk(fn))
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Dict):
+                    continue
+                items = _dict_str_items(node)
+                if (astutil.str_const(items.get("verb")) != "error"
+                        or astutil.str_const(items.get("kind")) != "push"):
+                    continue
+                if "breaker_peer" in items or assigns_blame:
+                    continue
+                findings.append(Finding(
+                    rule="wire-error-blame", path=mod.rel, line=node.lineno,
+                    anchor=f"{qual}:push-frame:{_msg_slug(node)}",
+                    message=f"{qual}: kind=push error frame decides no "
+                            "breaker_peer blame — if routing blame and "
+                            "breaker blame differ here (relay paths), the "
+                            "wrong breaker opens; if they coincide, say so "
+                            "in the baseline"))
+
+
+_DOC_ROW = re.compile(r"^\s*\|\s*`(\w+)`\s*\|\s*(\w+)\s*\|", re.M)
+
+
+def _check_doc_drift(ctx: Context, tax_mod: astutil.Module,
+                     taxonomy: Dict[str, Tuple[str, str]],
+                     findings: List[Finding]) -> None:
+    if "runtime/errors.py" not in tax_mod.rel:
+        return  # fixture taxonomy: no doc contract
+    doc = ctx.docs_text.get("docs/FAULT_TOLERANCE.md")
+    if doc is None:
+        findings.append(Finding(
+            rule="taxonomy-undocumented", path=tax_mod.rel, line=1,
+            anchor="FAULT_TOLERANCE.md",
+            message="docs/FAULT_TOLERANCE.md is missing — the taxonomy "
+                    "table lives there"))
+        return
+    documented = {m.group(1): m.group(2) for m in _DOC_ROW.finditer(doc)}
+    for name, (policy, _scope) in sorted(taxonomy.items()):
+        if name not in documented:
+            findings.append(Finding(
+                rule="taxonomy-undocumented", path=tax_mod.rel, line=1,
+                anchor=name,
+                message=f"TAXONOMY row {name} ({policy}) has no table row "
+                        "in docs/FAULT_TOLERANCE.md"))
+        elif documented[name] != policy:
+            findings.append(Finding(
+                rule="taxonomy-undocumented", path=tax_mod.rel, line=1,
+                anchor=f"{name}:{documented[name]}",
+                message=f"docs/FAULT_TOLERANCE.md documents {name} as "
+                        f"{documented[name]} but the catalog says "
+                        f"{policy}"))
+    for name in sorted(set(documented) - set(taxonomy)):
+        findings.append(Finding(
+            rule="taxonomy-unknown", path="docs/FAULT_TOLERANCE.md",
+            line=1, anchor=name,
+            message=f"docs/FAULT_TOLERANCE.md documents {name} but "
+                    "runtime/errors.py has no such TAXONOMY row"))
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+def analyze(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    mods = _scope_modules(ctx)
+    tax_mod = _taxonomy_module(ctx)
+    taxonomy = _parse_taxonomy(tax_mod) if tax_mod is not None else {}
+    if tax_mod is None:
+        # No catalog at all: every failure-plane exception is uncatalogued
+        # by definition; report the absence once instead of drowning.
+        findings.append(Finding(
+            rule="exc-uncatalogued", path=PLANE_DIRS[0], line=1,
+            anchor="errors.py",
+            message="no errors.py taxonomy module found — the failure "
+                    "plane has no machine-readable retryability catalog"))
+        return findings
+
+    retryable = ({n for n, (p, _s) in taxonomy.items() if p == "retryable"}
+                 | {"TimeoutError", "ConnectionError"})
+    catalogued = set(taxonomy) | {"TimeoutError", "ConnectionError"}
+    reach = _Reach(mods)
+
+    _check_catalog(mods, taxonomy, findings)
+    _check_swallowed(mods, reach, catalogued, findings)
+    _check_side_effects(mods, reach, retryable, findings)
+    _check_wire_blame(mods, findings)
+    _check_doc_drift(ctx, tax_mod, taxonomy, findings)
+    return findings

@@ -78,7 +78,8 @@ SHARDS = [
     # in-process swarms — grouped so their compiles share one process
     # without crowding the engine shards)
     ["test_events.py", "test_faults.py", "test_gossip.py",
-     "test_graftlint.py", "test_profiling.py", "test_telemetry.py"],
+     "test_graftlint.py", "test_graftlint_phase2.py", "test_profiling.py",
+     "test_telemetry.py"],
 ]
 
 
@@ -118,6 +119,21 @@ def main() -> int:
               flush=True)
         if rc != 0:
             failures.append((i, rc))
+
+    # Graftlint gate, as its own shard: the full analyzer suite against
+    # the real baseline (including the stale-entry check, which the
+    # in-test subprocess runs also exercise but this keeps as a distinct,
+    # cheap, first-class line in the suite output).
+    lint_i = len(SHARDS) + 1
+    print(f"[shard {lint_i}/{lint_i}] python -m scripts.graftlint",
+          flush=True)
+    t = time.time()
+    rc = subprocess.call([sys.executable, "-m", "scripts.graftlint"],
+                         cwd=REPO)
+    print(f"[shard {lint_i}] exit={rc} in {time.time() - t:.0f}s",
+          flush=True)
+    if rc != 0:
+        failures.append((lint_i, rc))
 
     # Completeness guard: a test file added without updating SHARDS must
     # fail the run, not silently skip.

@@ -1,0 +1,48 @@
+"""Positive controls for the determinism analyzer family: unseeded RNG
+fallbacks, clock-tainted seeds, and PRNGKey double-consumption. Parsed by
+graftlint, never imported."""
+
+import random
+import time
+
+import jax
+import numpy as np
+
+
+class Sampler:
+    def __init__(self, rng=None):
+        # det-unseeded-rng: both unseeded constructions.
+        self._rng = rng or random.Random()
+        self._np_rng = np.random.default_rng()
+
+    def clock_seed(self):
+        # det-taint: wall clock -> PRNGKey seed.
+        seed = time.monotonic_ns()
+        return jax.random.PRNGKey(seed)
+
+    def clock_session(self, submit):
+        # det-taint: clock-derived value into a session_id= sink.
+        sid = f"sess-{time.time_ns():x}"
+        submit(session_id=sid)
+
+
+def sample_twice(key):
+    # det-key-reuse: the same key consumed by two draws with no
+    # intervening split/fold_in -> identical, correlated samples.
+    a = jax.random.uniform(key)
+    b = jax.random.normal(key)
+    return a + b
+
+
+def sample_in_loop(key, steps):
+    # det-key-reuse: a loop that never rebinds the key it consumes.
+    out = []
+    for _ in range(steps):
+        out.append(jax.random.bits(key))
+    return out
+
+
+def sanctioned_burst(seed, n):
+    # Clean control: the PRNGKey(seed + i) burst idiom never trips the
+    # reuse rule (the key is constructed inline, per index).
+    return [jax.random.bits(jax.random.PRNGKey(seed + i)) for i in range(n)]

@@ -1,0 +1,24 @@
+"""Fixture taxonomy: the mini runtime/errors.py the failures analyzer
+parses when linting the fixture package. Parsed, never imported."""
+
+RETRYABLE = "retryable"
+PERMANENT = "permanent"
+
+
+def register(cls):
+    return cls
+
+
+def ErrorPolicy(**kw):  # noqa: N802 - mirrors the real catalog's row type
+    return kw
+
+
+TAXONOMY = {p["name"]: p for p in (
+    ErrorPolicy(name="FixtureRetryable", policy=RETRYABLE, blame="peer",
+                wire=None, scope="client", doc="retryable fixture row"),
+    ErrorPolicy(name="CataloguedButUnregistered", policy=RETRYABLE,
+                blame="peer", wire=None, scope="client",
+                doc="row exists; definition site lacks @register"),
+    ErrorPolicy(name="FixturePermanent", policy=PERMANENT, blame="none",
+                wire=None, scope="client", doc="permanent fixture row"),
+)}

@@ -1,0 +1,48 @@
+"""Positive controls for the failures analyzer family. Each function is
+named so the recovery-reachability BFS roots it (``_call_with_recovery``,
+``_handle_*``); the classes violate the catalog contract on purpose.
+Parsed by graftlint, never imported."""
+
+from .errors import register
+
+
+class UncataloguedError(RuntimeError):
+    """No TAXONOMY row and no catalogued ancestor -> exc-uncatalogued."""
+
+
+class CataloguedButUnregistered(RuntimeError):
+    """Has a TAXONOMY row but no @register decorator -> exc-unregistered."""
+
+
+@register
+class FixtureRetryable(RuntimeError):
+    """Catalogued AND registered: the clean control."""
+
+
+class Recovering:
+    def __init__(self):
+        self.journal = []
+        self.sock = None
+
+    def _call_with_recovery(self):
+        # exc-swallowed: broad handler in a recovery root that neither
+        # re-raises nor converts to a catalogued type.
+        try:
+            self._attempt()
+        except Exception:
+            self.journal = []
+        # exc-side-effect-before-raise: the journal grows, then a
+        # retryable raise hands the whole region back to the retry loop.
+        self.journal.append("entry")
+        if not self.sock:
+            raise FixtureRetryable("peer fell over")
+
+    def _attempt(self):
+        raise FixtureRetryable("transient")
+
+
+def _handle_push(target):
+    # wire-error-blame: a kind=push error frame with no breaker_peer
+    # decision anywhere in the function.
+    return {"verb": "error", "kind": "push", "peer": target,
+            "message": "fixture push failed badly"}
