@@ -1695,6 +1695,40 @@ def bench_profiler_overhead(step_ms_ref: float, iters=20000, reps=5):
     }
 
 
+def bench_graftlint_runtime(budget_s: float = 20.0, reps: int = 3):
+    """Static-analysis cost row: one full ``python -m scripts.graftlint``
+    run (all analyzer families, real baseline) must fit a wall-clock
+    budget, because scripts/run_tests.py runs it as the final shard AND as
+    the --changed-only pre-shard gate — a lint that creeps toward minutes
+    silently taxes every suite run. Best-of-reps wall clock of the full
+    subprocess (interpreter start + ~60-module parse + all families),
+    which is exactly what the suite pays."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    best = float("inf")
+    rc = 0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        r = subprocess.run(
+            [sys.executable, "-m", "scripts.graftlint"], cwd=repo,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=max(budget_s * 10, 120))
+        best = min(best, time.perf_counter() - t0)
+        rc = rc or r.returncode
+    return {
+        "wall_s": round(best, 3),
+        "budget_s": budget_s,
+        "exit_code": rc,
+        "pass_under_budget": bool(rc == 0 and best < budget_s),
+        "note": ("best-of-%d full graftlint subprocess runs (all "
+                 "families vs the real baseline); priced because the "
+                 "suite runs it per-invocation as a gate" % reps),
+    }
+
+
 def _device_reachable(timeout_s: float = 90.0) -> bool:
     """Probe backend init in a SUBPROCESS: a wedged axon tunnel hangs
     jax.devices() indefinitely, which would turn the driver's bench run
@@ -1905,6 +1939,10 @@ def main():
         rrec = bench_recorder_overhead(r["step_ms"])
         rprof = bench_profiler_overhead(r["step_ms"])
         try:
+            rlint = bench_graftlint_runtime(reps=1)
+        except Exception as exc:   # the lint row must not kill the smoke
+            rlint = {"error": str(exc)[:200]}
+        try:
             rgw = bench_gateway(cfg, params, splits=(2,), n_requests=4,
                                 max_new_tokens=4)
         except Exception as exc:   # the gateway row must not kill the smoke
@@ -1921,6 +1959,7 @@ def main():
                 "smoke_telemetry_overhead": rt,
                 "smoke_recorder_overhead": rrec,
                 "smoke_profiling": rprof,
+                "smoke_graftlint_runtime": rlint,
                 "smoke_gateway": rgw,
                 "smoke_relay": rrelay}
         print(json.dumps({"metric": "smoke", "value": r["tokens_per_s"],
@@ -2215,6 +2254,13 @@ def main():
             results["flagship_1b_b16"]["step_ms"])
     except Exception as exc:
         results["profiler_overhead"] = {"error": str(exc)[:200]}
+
+    # ISSUE 15 acceptance: the full graftlint run (the suite's lint gate)
+    # stays inside its wall-clock budget.
+    try:
+        results["graftlint_runtime"] = bench_graftlint_runtime()
+    except Exception as exc:
+        results["graftlint_runtime"] = {"error": str(exc)[:200]}
 
     primary = results["flagship_1b_b16"]
 

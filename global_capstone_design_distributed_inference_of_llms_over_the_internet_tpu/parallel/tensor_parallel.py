@@ -72,6 +72,27 @@ def tp_partition_rules(cfg: ModelConfig, axis: str = "tp"):
     return (*attn, *mlp, (r".*", P()))
 
 
+# Replicated-leaf registry: every shardable layer leaf that DELIBERATELY
+# rides the catch-all, with the reason. Replication must be a decision,
+# never a fall-through — a new leaf that matches neither a sharding rule
+# above nor a row here fails graftlint's spmd-catchall-leaf check, which
+# parses this table (regex, reason) without importing the module.
+REPLICATED_LEAVES = (
+    (r"ln[0-9]/(w|b)$",
+     "norm scale/shift are O(d): sharding saves nothing and would cost an "
+     "all-gather before every norm"),
+    (r"attn/bo$",
+     "output-projection bias is applied once to the closing psum's "
+     "replicated sum; a sharded copy would be counted tp times"),
+    (r"mlp/bo$",
+     "mlp output bias is applied after the closing psum, same layout "
+     "argument as attn/bo"),
+    (r"^window$",
+     "per-layer attention-window vector is [L] int32 config state, not a "
+     "weight — every rank needs the whole thing"),
+)
+
+
 def layer_partition_specs(cfg: ModelConfig, axis: str = "tp"):
     """Spec RESOLVER for stacked-layer leaves: returns a function
     (tree_map_with_path path) -> PartitionSpec for a [L, ...] leaf, rule-

@@ -103,6 +103,19 @@ def walk_functions(tree: ast.Module
     yield from rec(tree, [], None)
 
 
+def scope_walk(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested (async) function
+    defs — nested defs are separate call-graph nodes and get their own
+    walk. Lambdas ARE descended into: they share the enclosing scope."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
 def enclosing_map(func: ast.AST) -> Dict[ast.AST, ast.AST]:
     """child -> parent map for ancestor walks within one function body."""
     parents: Dict[ast.AST, ast.AST] = {}
@@ -110,6 +123,131 @@ def enclosing_map(func: ast.AST) -> Dict[ast.AST, ast.AST]:
         for child in ast.iter_child_nodes(node):
             parents[child] = node
     return parents
+
+
+# ---------------------------------------------------------------------------
+# Name-based call graph with unique-target discipline
+# ---------------------------------------------------------------------------
+#
+# Shared by the lock analyzer (only-called-from-locked fixpoint), the
+# failures analyzer (recovery-root reachability), and the spmd analyzer
+# (shard_map axis-scope reachability). One resolution policy, so the
+# families' reachability semantics cannot drift apart:
+#
+#   * ``self.m()`` resolves within the receiver's class;
+#   * bare names resolve to module-level defs, same module first;
+#   * a generic ``obj.m()`` resolves only when exactly ONE class
+#     package-wide defines ``m`` — common method names would otherwise
+#     weave phantom edges through every registry.
+
+class CallGraph:
+    """Function index + name-resolved call edges over a module set.
+
+    Nodes are ``(rel, qualname)`` keys; ``funcs`` maps each to its
+    ``(funcdef, class_name)``. ``edges`` resolves one function's outgoing
+    calls; ``reachable`` runs BFS from a root set.
+    """
+
+    def __init__(self, mods: List[Module]):
+        # (rel, qual) -> (funcdef, class_name)
+        self.funcs: Dict[Tuple[str, str],
+                         Tuple[ast.AST, Optional[str]]] = {}
+        # bare function name -> [(rel, qual)] (module-level defs only)
+        self.module_level: Dict[str, List[Tuple[str, str]]] = {}
+        # method name -> [(rel, qual, class)]
+        self.methods: Dict[str, List[Tuple[str, str, str]]] = {}
+        for mod in mods:
+            for qual, cls, fn in walk_functions(mod.tree):
+                self.funcs[(mod.rel, qual)] = (fn, cls)
+                name = qual.split(".")[-1]
+                if cls is None and "." not in qual:
+                    self.module_level.setdefault(name, []).append(
+                        (mod.rel, qual))
+                elif cls is not None and qual == f"{cls}.{name}":
+                    self.methods.setdefault(name, []).append(
+                        (mod.rel, qual, cls))
+
+    def _resolve_bare(self, rel: str, name: str,
+                      qual: Optional[str]) -> Optional[Tuple[str, str]]:
+        """A bare-name reference: same-module module-level def first, then
+        the caller's own nested def (lexical child — the `tick` loop-body
+        idiom where several factories each nest one), then any unique
+        same-module nested def, then a unique global module-level def."""
+        cands = [c for c in self.module_level.get(name, ())
+                 if c[0] == rel]
+        if not cands and qual is not None:
+            child = (rel, f"{qual}.{name}")
+            if child in self.funcs:
+                return child
+        if not cands:
+            cands = [k for k in self.funcs
+                     if k[0] == rel and "." in k[1]
+                     and k[1].split(".")[-1] == name]
+        cands = cands or self.module_level.get(name, [])
+        return cands[0] if len(cands) == 1 else None
+
+    def edges(self, rel: str, fn: ast.AST, cls: Optional[str],
+              qual: Optional[str] = None) -> List[Tuple[str, str]]:
+        out: List[Tuple[str, str]] = []
+        for node in ast.walk(fn):
+            # Bare-name LOADS, not just calls: a function passed by
+            # reference (`lax.fori_loop(0, n, tick, c)`, a callback wired
+            # into a constructor) is reachable the moment the reference
+            # escapes — the unique-target discipline keeps this precise.
+            if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                         ast.Load):
+                hit = self._resolve_bare(rel, node.id, qual)
+                if hit is not None:
+                    out.append(hit)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                f = node.func
+                owners = self.methods.get(f.attr, [])
+                if (isinstance(f.value, ast.Name) and f.value.id == "self"
+                        and cls is not None):
+                    same = [o[:2] for o in owners if o[2] == cls]
+                    if len(same) == 1:
+                        out.append(same[0])
+                    continue
+                # Generic receiver: resolve only on a unique target.
+                if len(owners) == 1:
+                    out.append(owners[0][:2])
+        return out
+
+    def reachable(self, roots) -> set:
+        """BFS closure of ``roots`` (an iterable of (rel, qual) keys)."""
+        queue = [k for k in roots if k in self.funcs]
+        seen = set(queue)
+        while queue:
+            rel, qual = queue.pop()
+            fn, cls = self.funcs[(rel, qual)]
+            for nxt in self.edges(rel, fn, cls, qual):
+                if nxt not in seen and nxt in self.funcs:
+                    seen.add(nxt)
+                    queue.append(nxt)
+        return seen
+
+
+def only_called_from_fixpoint(members, seeds, calls, skip=frozenset()):
+    """Grow ``seeds`` over a (caller, callee, flagged) call-site list until
+    fixpoint: a member joins when it HAS call sites and every one of them
+    is flagged — either the site itself (``flagged``) or its caller is
+    already in the set. The lock analyzer's only-called-from-locked-context
+    closure, shared so other families can reuse the discipline."""
+    grown = set(seeds)
+    changed = True
+    while changed:
+        changed = False
+        sites: Dict[str, List[bool]] = {}
+        for caller, callee, flagged in calls:
+            sites.setdefault(callee, []).append(flagged or caller in grown)
+        for m in members:
+            if m in grown or m in skip:
+                continue
+            if sites.get(m) and all(sites[m]):
+                grown.add(m)
+                changed = True
+    return grown
 
 
 def import_aliases(tree: ast.Module) -> Dict[str, str]:

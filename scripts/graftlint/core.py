@@ -152,7 +152,7 @@ def _registry() -> Dict[str, Callable[[Context], List[Finding]]]:
     # Imported lazily so `import scripts.graftlint` stays cheap and a bug
     # in one analyzer module doesn't break the others' entry points.
     from . import (determinism, dispatch, env_flags, failures, jax_hygiene,
-                   legacy, locks)
+                   legacy, locks, recompile, spmd, wire_schema)
 
     return {
         "locks": locks.analyze,
@@ -161,6 +161,9 @@ def _registry() -> Dict[str, Callable[[Context], List[Finding]]]:
         "env_flags": env_flags.analyze,
         "failures": failures.analyze,
         "determinism": determinism.analyze,
+        "spmd": spmd.analyze,
+        "recompile": recompile.analyze,
+        "wire_schema": wire_schema.analyze,
         "bare_print": legacy.analyze_bare_print,
         "metrics_doc": legacy.analyze_metrics_doc,
         "cli_doc": legacy.analyze_cli_doc,
@@ -170,6 +173,7 @@ def _registry() -> Dict[str, Callable[[Context], List[Finding]]]:
 
 ALL_ANALYZERS: Tuple[str, ...] = (
     "locks", "jax", "dispatch", "env_flags", "failures", "determinism",
+    "spmd", "recompile", "wire_schema",
     "bare_print", "metrics_doc", "cli_doc", "quant_coverage",
 )
 

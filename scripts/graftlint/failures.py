@@ -35,10 +35,11 @@ Rules:
   (a row per class with its policy; a mismatched policy counts as
   undocumented).
 
-Precision notes: reachability is the same name-based BFS the jax
-analyzer uses — ``self.m()`` resolves within the class, bare names within
-the module, and a generic ``obj.m()`` only when exactly ONE failure-plane
-class defines ``m`` (the lock analyzer's unique-target discipline).
+Precision notes: reachability is ``astutil.CallGraph`` — the shared
+name-based walker (``self.m()`` resolves within the class, bare names
+within the module, and a generic ``obj.m()`` only when exactly ONE
+failure-plane class defines ``m``: the unique-target discipline the lock
+analyzer's fixpoint shares).
 Everything here parses ASTs; the errors module is never imported.
 """
 
@@ -223,66 +224,15 @@ def _is_root(qual: str, cls: Optional[str]) -> bool:
 
 
 class _Reach:
-    """Recovery-reachable function set over the failure plane."""
+    """Recovery-reachable function set over the failure plane — a thin
+    binding of astutil.CallGraph (the shared unique-target walker) to the
+    recovery-root predicate."""
 
     def __init__(self, mods: List[astutil.Module]):
-        # (rel, qual) -> (funcdef, class_name)
-        self.funcs: Dict[Tuple[str, str], Tuple[ast.AST, Optional[str]]] = {}
-        # bare function name -> [(rel, qual)] (module-level defs only)
-        self.module_level: Dict[str, List[Tuple[str, str]]] = {}
-        # method name -> [(rel, qual, class)]
-        self.methods: Dict[str, List[Tuple[str, str, str]]] = {}
-        for mod in mods:
-            for qual, cls, fn in astutil.walk_functions(mod.tree):
-                self.funcs[(mod.rel, qual)] = (fn, cls)
-                name = qual.split(".")[-1]
-                if cls is None and "." not in qual:
-                    self.module_level.setdefault(name, []).append(
-                        (mod.rel, qual))
-                elif cls is not None and qual == f"{cls}.{name}":
-                    self.methods.setdefault(name, []).append(
-                        (mod.rel, qual, cls))
-        self.reachable = self._bfs()
-
-    def _edges(self, rel: str, fn: ast.AST,
-               cls: Optional[str]) -> List[Tuple[str, str]]:
-        out = []
-        for call in ast.walk(fn):
-            if not isinstance(call, ast.Call):
-                continue
-            f = call.func
-            if isinstance(f, ast.Name):
-                cands = [c for c in self.module_level.get(f.id, ())
-                         if c[0] == rel]
-                cands = cands or self.module_level.get(f.id, [])
-                if len(cands) == 1:
-                    out.append(cands[0])
-            elif isinstance(f, ast.Attribute):
-                owners = self.methods.get(f.attr, [])
-                if (isinstance(f.value, ast.Name) and f.value.id == "self"
-                        and cls is not None):
-                    same = [o[:2] for o in owners if o[2] == cls]
-                    if len(same) == 1:
-                        out.append(same[0])
-                    continue
-                # Generic receiver: resolve only on a unique target —
-                # common method names would weave phantom reachability.
-                if len(owners) == 1:
-                    out.append(owners[0][:2])
-        return out
-
-    def _bfs(self) -> Set[Tuple[str, str]]:
-        queue = [key for key, (_, cls) in self.funcs.items()
-                 if _is_root(key[1], cls)]
-        seen = set(queue)
-        while queue:
-            rel, qual = queue.pop()
-            fn, cls = self.funcs[(rel, qual)]
-            for nxt in self._edges(rel, fn, cls):
-                if nxt not in seen and nxt in self.funcs:
-                    seen.add(nxt)
-                    queue.append(nxt)
-        return seen
+        self.graph = astutil.CallGraph(mods)
+        self.reachable = self.graph.reachable(
+            key for key, (_fn, cls) in self.graph.funcs.items()
+            if _is_root(key[1], cls))
 
 
 # ---------------------------------------------------------------------------

@@ -267,23 +267,15 @@ def _analyze_class(cls: ast.ClassDef, rel: str) -> Optional[_ClassInfo]:
     ci = _ClassInfo(cls.name, rel, cls.lineno, lock_attrs, methods)
 
     # Held-method closure: *_locked by convention, then any method whose
-    # every intra-class call site is itself in locked context, to fixpoint.
-    held = {m for m in methods if m.endswith("_locked")}
-    changed = True
-    while changed:
-        changed = False
-        sites: Dict[str, List[bool]] = {}
-        for caller, mi in methods.items():
-            caller_locked = caller in held
-            for callee, locked in mi.self_calls:
-                sites.setdefault(callee, []).append(locked or caller_locked)
-        for m in methods:
-            if m in held or m in IGNORED_METHODS:
-                continue
-            if sites.get(m) and all(sites[m]):
-                held.add(m)
-                changed = True
-    ci.held = held
+    # every intra-class call site is itself in locked context, to fixpoint
+    # (the shared only-called-from discipline in astutil).
+    ci.held = astutil.only_called_from_fixpoint(
+        members=methods,
+        seeds={m for m in methods if m.endswith("_locked")},
+        calls=[(caller, callee, locked)
+               for caller, mi in methods.items()
+               for callee, locked in mi.self_calls],
+        skip=IGNORED_METHODS)
     return ci
 
 

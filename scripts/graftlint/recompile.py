@@ -1,0 +1,353 @@
+"""jit cache-key / recompilation-hazard invariants (phase 3).
+
+ROADMAP item 1 blames the serving hot path's tail latency on retrace
+storms. Every shape below is a way to make XLA compile more often than the
+program text suggests, and none of them crash — they just burn minutes:
+
+  * ``recompile-jit-in-loop``: ``jax.jit(...)`` constructed inside a
+    ``for``/``while`` body. Each construction wraps a fresh callable (the
+    usual culprit is a closure or lambda), so the pjit cache misses every
+    iteration.
+  * ``recompile-jit-per-call``: ``jax.jit(f)(x)`` invoked immediately, or
+    a jit assigned to a local that is called but never escapes the
+    function (not returned, not stored on ``self``/a container, not passed
+    on) — the wrapper dies with the frame and is rebuilt per call.
+  * ``recompile-dynamic-scalar``: a Python scalar derived from ``len()``
+    or ``.shape[...]`` arithmetic flowing into a NON-static position of a
+    locally known jitted callable. Every distinct value is a new trace;
+    the fix is bucketing/padding or ``static_argnums`` when the arity is
+    genuinely small.
+  * ``recompile-self-closure``: a function traced by ``jit``/``pjit``/
+    ``shard_map`` that reads ``self.X`` where the class reassigns ``X``
+    outside ``__init__``. The closure captures the attribute BY OBJECT at
+    trace time — later reassignment silently keeps serving the stale
+    constant (or retraces, depending on hashability); either way the
+    dependence is invisible to the cache key.
+
+Precision notes. All resolution is name-based and module-local: a call
+only checks against jitted callables defined or wired (``self.X =
+jax.jit(...)``) in the same module, so common method names elsewhere
+cannot create phantom hazards. Taint is intraprocedural with no
+call-through — a scalar laundered through a helper (e.g. a bucketing
+round-up) is deliberately NOT tainted, because bucketing is the sanctioned
+fix for exactly this hazard. ``self.X`` closures are only flagged when
+the same class provably reassigns ``X`` outside ``__init__``; config
+attributes set once are stable and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import astutil
+from .core import Context, Finding
+
+JIT_NAMES = {"jit", "pjit"}
+TRACE_WRAPPERS = {"jit", "pjit", "shard_map", "pmap", "engine_donation"}
+
+
+def _jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """The jit-ish construction Call when `node` is one: ``jax.jit(...)``,
+    ``pjit(...)``, ``partial(jax.jit, ...)``, ``engine_donation(...)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = astutil.terminal_attr(node)
+    if name in JIT_NAMES or name == "engine_donation":
+        return node
+    if name == "partial" and node.args:
+        inner = node.args[0]
+        if isinstance(inner, (ast.Name, ast.Attribute)):
+            if (inner.id if isinstance(inner, ast.Name)
+                    else inner.attr) in JIT_NAMES:
+                return node
+    return None
+
+
+def _statics(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        v = kw.value
+        elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        if kw.arg == "static_argnums":
+            nums |= {e.value for e in elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, int)}
+        elif kw.arg == "static_argnames":
+            names |= {e.value for e in elts
+                      if isinstance(e, ast.Constant)
+                      and isinstance(e.value, str)}
+    return nums, names
+
+
+def _is_traced_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, (ast.Name, ast.Attribute)):
+        return (dec.id if isinstance(dec, ast.Name)
+                else dec.attr) in TRACE_WRAPPERS
+    if isinstance(dec, ast.Call):
+        return _jit_call(dec) is not None
+    return False
+
+
+def _module_jit_census(mod: astutil.Module):
+    """(names, attrs): jitted callables resolvable within this module,
+    each mapping to its (static_argnums, static_argnames)."""
+    names: Dict[str, Tuple[Set[int], Set[str]]] = {}
+    attrs: Dict[str, Tuple[Set[int], Set[str]]] = {}
+    for _qual, _cls, fn in astutil.walk_functions(mod.tree):
+        for dec in fn.decorator_list:
+            if _is_traced_decorator(dec):
+                names[fn.name] = (_statics(dec)
+                                  if isinstance(dec, ast.Call)
+                                  else (set(), set()))
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.value, ast.Call)):
+            jc = _jit_call(node.value)
+            if jc is None:
+                continue
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                names[t.id] = _statics(jc)
+            else:
+                attr = astutil.is_self_attr(t)
+                if attr:
+                    attrs[attr] = _statics(jc)
+    return names, attrs
+
+
+# ---------------------------------------------------------------------------
+# Construction-site hazards
+# ---------------------------------------------------------------------------
+
+def _construction_findings(mod: astutil.Module) -> List[Finding]:
+    findings: List[Finding] = []
+    for qual, _cls, fn in astutil.walk_functions(mod.tree):
+        parents = None
+        jit_locals: Dict[str, ast.Assign] = {}
+        for node in astutil.scope_walk(fn):
+            if isinstance(node, ast.Call):
+                # jax.jit(f)(x): the wrapper never survives the statement.
+                # partial(jax.jit, ...)(f) is exempt — that is the
+                # decorator-application idiom; the outer call BUILDS the
+                # wrapper (which the caller keeps) rather than invoking it.
+                if (isinstance(node.func, ast.Call)
+                        and _jit_call(node.func) is not None
+                        and astutil.terminal_attr(node.func) != "partial"):
+                    findings.append(Finding(
+                        "recompile-jit-per-call", mod.rel, node.lineno,
+                        qual,
+                        f"`{qual}` wraps and immediately invokes jit — the "
+                        "wrapper dies with the statement, so every call "
+                        "recompiles"))
+                jc = _jit_call(node)
+                if jc is not None:
+                    if parents is None:
+                        parents = astutil.enclosing_map(fn)
+                    cur = node
+                    while cur in parents:
+                        cur = parents[cur]
+                        if isinstance(cur, (ast.For, ast.While)):
+                            findings.append(Finding(
+                                "recompile-jit-in-loop", mod.rel,
+                                node.lineno, qual,
+                                f"`{qual}` constructs jit inside a loop — "
+                                "each iteration wraps a fresh callable "
+                                "and misses the trace cache"))
+                            break
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                    and _jit_call(node.value) is not None):
+                jit_locals[node.targets[0].id] = node
+        # jit assigned to a local that is called but never escapes: the
+        # wrapper is rebuilt on every call of the enclosing function.
+        for name, assign in jit_locals.items():
+            called = escapes = False
+            call_fns = {id(n.func) for n in astutil.scope_walk(fn)
+                        if isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Name)
+                        and n.func.id == name}
+            called = bool(call_fns)
+            for node in astutil.scope_walk(fn):
+                if (isinstance(node, ast.Name) and node.id == name
+                        and isinstance(node.ctx, ast.Load)
+                        and id(node) not in call_fns):
+                    escapes = True
+            if called and not escapes:
+                findings.append(Finding(
+                    "recompile-jit-per-call", mod.rel, assign.lineno,
+                    f"{qual}:{name}",
+                    f"`{qual}` builds jit into local `{name}`, calls it, "
+                    "and never lets it escape — the wrapper (and its "
+                    "trace cache) is rebuilt on every call of "
+                    f"`{qual}`"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Dynamic-scalar taint into traced positions
+# ---------------------------------------------------------------------------
+
+def _is_scalar_source(node: ast.AST, tainted: Set[str]) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Call):
+        fname = astutil.terminal_attr(node)
+        if fname == "len":
+            return True
+        if fname in ("int", "min", "max", "abs") and node.args:
+            return any(_is_scalar_source(a, tainted) for a in node.args)
+        return False
+    if isinstance(node, ast.Subscript):
+        v = node.value
+        return isinstance(v, ast.Attribute) and v.attr == "shape"
+    if isinstance(node, ast.BinOp):
+        return (_is_scalar_source(node.left, tainted)
+                or _is_scalar_source(node.right, tainted))
+    if isinstance(node, ast.UnaryOp):
+        return _is_scalar_source(node.operand, tainted)
+    return False
+
+
+def _taint_findings(mod: astutil.Module) -> List[Finding]:
+    findings: List[Finding] = []
+    names, attrs = _module_jit_census(mod)
+    if not (names or attrs):
+        return findings
+    for qual, _cls, fn in astutil.walk_functions(mod.tree):
+        tainted: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in astutil.scope_walk(fn):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    continue
+                t = node.targets[0].id
+                if t not in tainted and _is_scalar_source(node.value,
+                                                          tainted):
+                    tainted.add(t)
+                    changed = True
+        for node in astutil.scope_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name):
+                statics = names.get(node.func.id)
+            elif astutil.is_self_attr(node.func):
+                statics = attrs.get(node.func.attr)
+            else:
+                statics = None
+            if statics is None:
+                continue
+            snums, snames = statics
+            callee = astutil.terminal_attr(node)
+            for p, a in enumerate(node.args):
+                if p in snums or not _is_scalar_source(a, tainted):
+                    continue
+                what = a.id if isinstance(a, ast.Name) else "expr"
+                findings.append(Finding(
+                    "recompile-dynamic-scalar", mod.rel, node.lineno,
+                    f"{qual}:{callee}:{p}",
+                    f"`{qual}` passes a len()/shape-derived Python scalar "
+                    f"(`{what}`) at position {p} of jitted `{callee}` — "
+                    "every distinct value is a fresh trace; bucket it or "
+                    "mark the position static"))
+            for kw in node.keywords:
+                if (kw.arg and kw.arg not in snames
+                        and _is_scalar_source(kw.value, tainted)):
+                    findings.append(Finding(
+                        "recompile-dynamic-scalar", mod.rel, node.lineno,
+                        f"{qual}:{callee}:{kw.arg}",
+                        f"`{qual}` passes a len()/shape-derived Python "
+                        f"scalar as `{kw.arg}=` of jitted `{callee}` — "
+                        "every distinct value is a fresh trace; bucket it "
+                        "or add it to static_argnames"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Mutable-self closures inside traced bodies
+# ---------------------------------------------------------------------------
+
+def _class_mutable_attrs(mod: astutil.Module) -> Dict[str, Set[str]]:
+    """class name -> attrs assigned via ``self.X = ...`` OUTSIDE
+    __init__/__post_init__ (i.e. genuinely mutable state)."""
+    out: Dict[str, Set[str]] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        mutable: Set[str] = set()
+        for stmt in node.body:
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if stmt.name in ("__init__", "__post_init__"):
+                continue
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.Assign, ast.AugAssign)):
+                    targets = (sub.targets
+                               if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    for t in targets:
+                        attr = astutil.is_self_attr(t)
+                        if attr:
+                            mutable.add(attr)
+        out[node.name] = mutable
+    return out
+
+
+def _traced_functions(mod: astutil.Module):
+    """(qual, cls, fn) for functions traced by decorator or by being
+    passed (by name / ``self.attr``) to a tracing wrapper call."""
+    all_fns = list(astutil.walk_functions(mod.tree))
+    traced_names: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and astutil.terminal_attr(node) in TRACE_WRAPPERS):
+            continue
+        target = node.args[0] if node.args else None
+        if (isinstance(target, ast.Call)
+                and astutil.terminal_attr(target) == "partial"
+                and target.args):
+            target = target.args[0]
+        if isinstance(target, ast.Name):
+            traced_names.add(target.id)
+        elif isinstance(target, ast.Attribute):
+            attr = astutil.is_self_attr(target)
+            if attr:
+                traced_names.add(attr)
+    for qual, cls, fn in all_fns:
+        if (any(_is_traced_decorator(d) for d in fn.decorator_list)
+                or fn.name in traced_names):
+            yield qual, cls, fn
+
+
+def _self_closure_findings(mod: astutil.Module) -> List[Finding]:
+    findings: List[Finding] = []
+    mutable = _class_mutable_attrs(mod)
+    for qual, cls, fn in _traced_functions(mod):
+        if cls is None or cls not in mutable:
+            continue
+        for node in astutil.scope_walk(fn):
+            attr = astutil.is_self_attr(node, mutable[cls])
+            if attr is None or not isinstance(node.ctx, ast.Load):
+                continue
+            findings.append(Finding(
+                "recompile-self-closure", mod.rel, node.lineno,
+                f"{qual}:{attr}",
+                f"traced `{qual}` closes over mutable `self.{attr}` "
+                f"(reassigned outside {cls}.__init__) — the trace bakes "
+                "in the value at first call and never sees updates"))
+    return findings
+
+
+def analyze(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        findings += _construction_findings(mod)
+        findings += _taint_findings(mod)
+        findings += _self_closure_findings(mod)
+    return findings

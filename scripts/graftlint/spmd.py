@@ -1,0 +1,535 @@
+"""SPMD sharding-discipline invariants (phase 3).
+
+The GSPMD-lineage failure mode: a parameter path that silently falls to
+the replicated catch-all costs the entire sharding win (or, for a weight
+with a sharded sibling, correctness after the closing psum) — and nothing
+catches it before an on-chip run. Four rule groups:
+
+  * ``spmd-catchall-leaf``: every shardable model-tree leaf path (statically
+    extracted from ``init_layer_params``'s dict-literal / subscript-store
+    structure, plus per-layer leaves ``init_params`` adds to the stacked
+    tree) must match a non-catch-all ``tp_partition_rules`` regex in some
+    config variant, or match an entry of the ``REPLICATED_LEAVES``
+    (regex, reason) table next to the rules — replication must be a
+    decision with a written reason, never a fall-through.
+  * ``spmd-replicated-no-reason``: a REPLICATED_LEAVES entry whose reason
+    is empty — the table exists to carry the why.
+  * ``spmd-rule-shadowed``: first-regex-wins means an earlier rule can
+    subsume a later one; a non-catch-all rule that is never the first
+    match for any corpus path in any variant it appears in is dead weight
+    (and very likely a misordered edit).
+  * ``spmd-axis-unbound``: a collective (``psum``/``all_gather``/
+    ``axis_index``/``ppermute``/...) naming a string-literal axis must be
+    reachable — via the shared :class:`astutil.CallGraph` walker — from a
+    function traced by ``shard_map``/``pmap`` (or sit lexically inside a
+    ``shard_map`` lambda). An unbound axis name raises only at trace time
+    on-TPU; the lint moves that to tier-1.
+  * donation discipline at the ``donate_argnums`` sites:
+    ``spmd-missed-donation`` — a caller's loop rebinds a buffer through a
+    jitted step whose donate set omits that position (double KV memory);
+    ``spmd-use-after-donate`` — a donated argument is read after the
+    jitted call (garbage on TPU, where donation really invalidates).
+
+Precision notes. The leaf corpus and the rule table are parsed, never
+imported; config-conditional branches (moe vs dense mlp, bias toggles)
+become VARIANTS, and a leaf is covered when ANY variant covers it —
+branches mirror the config that creates the leaf, which a no-import
+analyzer cannot correlate. Collectives with non-literal axis arguments are
+the caller's responsibility and exempt. Donation checks only apply to
+callables that declare a donate set (``donate_argnums`` decorators,
+``engine_donation``, jit-call assignments, and constructor-kwarg wiring
+like ``RingDecoder(_step=step)``); a name that maps to conflicting donate
+sets is dropped as ambiguous rather than guessed.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import astutil
+from .core import Context, Finding
+
+COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "psum_scatter", "ppermute", "pshuffle", "pbroadcast", "axis_index",
+}
+SPMD_WRAPPERS = {"shard_map", "pmap", "xmap"}
+CATCHALL = {".*", "^.*$"}
+
+
+# ---------------------------------------------------------------------------
+# Leaf corpus: the shardable model tree, parsed from the init functions
+# ---------------------------------------------------------------------------
+
+def _dict_paths(d: ast.Dict, prefix: str, out: Dict[str, int]) -> None:
+    for k, v in zip(d.keys, d.values):
+        key = astutil.str_const(k) if k is not None else None
+        if key is None:
+            continue
+        path = f"{prefix}/{key}" if prefix else key
+        if isinstance(v, ast.Dict):
+            _dict_paths(v, path, out)
+        else:
+            out.setdefault(path, v.lineno)
+
+
+def _store_path(node: ast.Subscript) -> Optional[Tuple[str, List[str]]]:
+    """``p["attn"]["bq"]`` -> ("p", ["attn", "bq"]); None if dynamic."""
+    keys: List[str] = []
+    while isinstance(node, ast.Subscript):
+        sl = node.slice
+        if isinstance(sl, ast.Index):     # pragma: no cover — py<3.9 only
+            sl = sl.value
+        key = astutil.str_const(sl)
+        if key is None:
+            return None
+        keys.append(key)
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id, list(reversed(keys))
+    return None
+
+
+def _leaf_corpus(ctx: Context):
+    """(paths -> first line, module rel) for the per-layer shardable tree,
+    or None when no ``init_layer_params`` exists (fixture trees opt in by
+    defining one)."""
+    for mod in ctx.modules:
+        fns = {qual.split(".")[-1]: fn
+               for qual, _cls, fn in astutil.walk_functions(mod.tree)}
+        init_layer = fns.get("init_layer_params")
+        if init_layer is None:
+            continue
+        paths: Dict[str, int] = {}
+        roots = {node.value.id for node in ast.walk(init_layer)
+                 if isinstance(node, ast.Return)
+                 and isinstance(node.value, ast.Name)}
+        for node in astutil.scope_walk(init_layer):
+            if (isinstance(node, ast.AnnAssign)
+                    and isinstance(node.target, ast.Name)
+                    and node.target.id in roots
+                    and isinstance(node.value, ast.Dict)):
+                _dict_paths(node.value, "", paths)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id in roots \
+                            and isinstance(node.value, ast.Dict):
+                        _dict_paths(node.value, "", paths)
+                    elif isinstance(t, ast.Subscript):
+                        sp = _store_path(t)
+                        if sp is None or sp[0] not in roots:
+                            continue
+                        prefix = "/".join(sp[1])
+                        if isinstance(node.value, ast.Dict):
+                            _dict_paths(node.value, prefix, paths)
+                        else:
+                            paths.setdefault(prefix, node.lineno)
+        # Per-layer leaves init_params adds to the STACKED tree (e.g. the
+        # gemma2 `window` vector): subscript stores on the variable bound
+        # to the returned dict's "layers" key.
+        init_params = fns.get("init_params")
+        if init_params is not None:
+            layer_vars: Set[str] = set()
+            for node in astutil.scope_walk(init_params):
+                if isinstance(node, ast.Dict):
+                    for k, v in zip(node.keys, node.values):
+                        if (k is not None
+                                and astutil.str_const(k) == "layers"
+                                and isinstance(v, ast.Name)):
+                            layer_vars.add(v.id)
+            for node in astutil.scope_walk(init_params):
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Subscript)):
+                    sp = _store_path(node.targets[0])
+                    if sp and sp[0] in layer_vars:
+                        paths.setdefault("/".join(sp[1]), node.lineno)
+        return paths, mod.rel
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Partition-rule table: variants, coverage, shadowing
+# ---------------------------------------------------------------------------
+
+def _rule_tuples(node: ast.AST) -> Optional[List[Tuple[str, int]]]:
+    """A tuple-of-(regex, spec) literal -> [(regex, line)], else None."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for elt in node.elts:
+        if not (isinstance(elt, ast.Tuple) and len(elt.elts) >= 2):
+            return None
+        rx = astutil.str_const(elt.elts[0])
+        if rx is None:
+            return None
+        out.append((rx, elt.lineno))
+    return out
+
+
+def _rule_variants(fn: ast.AST) -> List[List[Tuple[str, int]]]:
+    """Expand ``return (*attn, *mlp, catchall)`` over every branch
+    assignment of the starred names: the cross-product of per-name
+    choices, each an ordered rule list."""
+    choices: Dict[str, List[List[Tuple[str, int]]]] = {}
+    for node in astutil.scope_walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            rules = _rule_tuples(node.value)
+            if rules is not None:
+                choices.setdefault(node.targets[0].id, []).append(rules)
+    ret = next((n for n in astutil.scope_walk(fn)
+                if isinstance(n, ast.Return)
+                and isinstance(n.value, ast.Tuple)), None)
+    if ret is None:
+        return []
+    variants: List[List[Tuple[str, int]]] = [[]]
+    for elt in ret.value.elts:
+        if isinstance(elt, ast.Starred) and isinstance(elt.value, ast.Name):
+            opts = choices.get(elt.value.id)
+            if not opts:
+                continue
+            variants = [v + opt for v in variants for opt in opts]
+        else:
+            direct = _rule_tuples(ast.Tuple(elts=[elt], ctx=ast.Load())) \
+                if isinstance(elt, ast.Tuple) else None
+            if direct:
+                variants = [v + direct for v in variants]
+    return variants
+
+
+def _replicated_table(mod: astutil.Module):
+    """Module-level ``REPLICATED_LEAVES = ((regex, reason), ...)`` ->
+    [(regex, reason, line)]."""
+    for node in mod.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "REPLICATED_LEAVES"
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            out = []
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Tuple) and len(elt.elts) == 2:
+                    rx = astutil.str_const(elt.elts[0])
+                    reason = astutil.str_const(elt.elts[1])
+                    if rx is not None:
+                        out.append((rx, reason or "", elt.lineno))
+            return out
+    return []
+
+
+def _coverage_findings(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    corpus = _leaf_corpus(ctx)
+    rules_fn = rules_mod = None
+    for mod in ctx.modules:
+        for qual, _cls, fn in astutil.walk_functions(mod.tree):
+            if qual.split(".")[-1] == "tp_partition_rules":
+                rules_fn, rules_mod = fn, mod
+                break
+        if rules_fn:
+            break
+    if corpus is None or rules_fn is None:
+        return findings
+    paths, corpus_rel = corpus
+    variants = _rule_variants(rules_fn)
+    replicated = _replicated_table(rules_mod)
+
+    for rx, reason, line in replicated:
+        if not reason.strip():
+            findings.append(Finding(
+                "spmd-replicated-no-reason", rules_mod.rel, line, rx,
+                f"REPLICATED_LEAVES entry `{rx}` has no reason — explicit "
+                "replication must say why the leaf stays whole"))
+
+    for path in sorted(paths):
+        covered = any(
+            re.search(rx, path)
+            for variant in variants
+            for rx, _line in variant if rx not in CATCHALL)
+        covered = covered or any(
+            re.search(rx, path) for rx, _r, _l in replicated)
+        if not covered:
+            findings.append(Finding(
+                "spmd-catchall-leaf", corpus_rel, paths[path], path,
+                f"model leaf `{path}` matches no non-catch-all "
+                "tp_partition_rules regex and no REPLICATED_LEAVES entry — "
+                "it replicates by fall-through, not by decision"))
+
+    # Shadowing: per variant, which rule wins first for each path.
+    first_wins: Dict[Tuple[str, int], bool] = {}
+    matches_any: Dict[Tuple[str, int], bool] = {}
+    for variant in variants:
+        for path in paths:
+            winner = next(((rx, line) for rx, line in variant
+                           if re.search(rx, path)), None)
+            for rx, line in variant:
+                if rx in CATCHALL:
+                    continue
+                hit = bool(re.search(rx, path))
+                matches_any[(rx, line)] = matches_any.get(
+                    (rx, line), False) or hit
+                first_wins[(rx, line)] = first_wins.get(
+                    (rx, line), False) or ((rx, line) == winner)
+    for (rx, line), wins in sorted(first_wins.items(),
+                                   key=lambda kv: kv[0][1]):
+        if wins:
+            continue
+        kind = ("shadowed by an earlier rule"
+                if matches_any.get((rx, line)) else
+                "matches no model leaf at all (dead)")
+        findings.append(Finding(
+            "spmd-rule-shadowed", rules_mod.rel, line, rx,
+            f"partition rule `{rx}` is never the first match for any "
+            f"model leaf in any config variant — {kind}; first-regex-wins "
+            "makes it unreachable"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Axis binding: collectives must be reachable from an SPMD-traced root
+# ---------------------------------------------------------------------------
+
+def _spmd_roots(mods: List[astutil.Module], graph: astutil.CallGraph):
+    """(root keys, lexically-bound lambda nodes) from shard_map/pmap call
+    sites. A bare-Name first argument matches every same-module def of
+    that name — the factory idiom (``body = _ring_body(...)`` closing over
+    a nested ``def body``) resolves by name, deliberately over-approximate
+    in the safe direction (fewer false unbound findings)."""
+    roots: Set[Tuple[str, str]] = set()
+    bound_lambdas: Set[int] = set()
+    for mod in mods:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and astutil.terminal_attr(node) in SPMD_WRAPPERS):
+                continue
+            target = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "f"), None)
+            if (isinstance(target, ast.Call)
+                    and astutil.terminal_attr(target) == "partial"
+                    and target.args):
+                target = target.args[0]
+            if isinstance(target, ast.Lambda):
+                for sub in ast.walk(target):
+                    bound_lambdas.add(id(sub))
+            elif isinstance(target, ast.Name):
+                for key in graph.funcs:
+                    if (key[0] == mod.rel
+                            and key[1].split(".")[-1] == target.id):
+                        roots.add(key)
+            elif isinstance(target, ast.Attribute):
+                owner = astutil.is_self_attr(target)
+                if owner:
+                    for key in graph.funcs:
+                        if key[1].split(".")[-1] == owner:
+                            roots.add(key)
+    return roots, bound_lambdas
+
+
+def _axis_findings(ctx: Context, graph: astutil.CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    roots, bound_lambdas = _spmd_roots(ctx.modules, graph)
+    reachable = graph.reachable(roots)
+    for (rel, qual), (fn, _cls) in graph.funcs.items():
+        if (rel, qual) in reachable:
+            continue
+        for node in astutil.scope_walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and astutil.terminal_attr(node) in COLLECTIVES):
+                continue
+            if id(node) in bound_lambdas:
+                continue
+            axis = next(
+                (s for s in ([astutil.str_const(a) for a in node.args]
+                             + [astutil.str_const(kw.value)
+                                for kw in node.keywords
+                                if kw.arg in ("axis_name", "axis")])
+                 if s is not None), None)
+            if axis is None:
+                continue                     # caller-bound axis: exempt
+            coll = astutil.terminal_attr(node)
+            findings.append(Finding(
+                "spmd-axis-unbound", rel, node.lineno,
+                f"{qual}:{coll}:{axis}",
+                f"collective `{coll}` names axis '{axis}' but `{qual}` is "
+                "not reachable from any shard_map/pmap-traced function — "
+                "an unbound axis name fails only at trace time on-TPU"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Donation discipline
+# ---------------------------------------------------------------------------
+
+def _donate_set(call: ast.Call) -> Optional[Set[int]]:
+    """donate_argnums from a jit-ish call, or engine_donation(a, b)."""
+    name = astutil.terminal_attr(call)
+    if name == "engine_donation":
+        out = set()
+        for a in call.args:
+            if isinstance(a, ast.Constant) and isinstance(a.value, int):
+                out.add(a.value)
+        return out or None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        out = set()
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.add(e.value)
+        return out or None
+    return None
+
+
+def _donation_census(mods: List[astutil.Module]):
+    """(names, attrs): bare callable name -> donate set (decorators and
+    jit-call assignments) and attribute name -> donate set (constructor-
+    kwarg wiring like ``RingDecoder(_step=step)`` and ``self._step =
+    jax.jit(...)`` stores). Split so a bare call never matches through an
+    unrelated method of the same name. Conflicting sets for one name drop
+    the name (ambiguous beats wrong)."""
+    names: Dict[str, Set[int]] = {}
+    attrs: Dict[str, Set[int]] = {}
+    conflicted: Set[Tuple[int, str]] = set()
+
+    def put(census: Dict[str, Set[int]], name: str, dset: Set[int]):
+        tag = (id(census), name)
+        if tag in conflicted:
+            return
+        if name in census and census[name] != dset:
+            del census[name]
+            conflicted.add(tag)
+            return
+        census[name] = dset
+
+    for mod in mods:
+        for qual, _cls, fn in astutil.walk_functions(mod.tree):
+            for dec in getattr(fn, "decorator_list", []):
+                if not isinstance(dec, ast.Call):
+                    continue
+                dset = _donate_set(dec)
+                if dset:
+                    put(names, fn.name, dset)
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.value, ast.Call)):
+                dset = _donate_set(node.value)
+                if not dset:
+                    continue
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    put(names, t.id, dset)
+                else:
+                    attr = astutil.is_self_attr(t)
+                    if attr:
+                        put(attrs, attr, dset)
+    # Constructor kwargs aliasing a donating callable to an attribute
+    # (RingDecoder(_step=step) -> self._step donates like step).
+    for mod in mods:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if (kw.arg and isinstance(kw.value, ast.Name)
+                        and kw.value.id in names):
+                    put(attrs, kw.arg, names[kw.value.id])
+    return names, attrs
+
+
+def _donation_findings(ctx: Context,
+                       graph: astutil.CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    name_census, attr_census = _donation_census(ctx.modules)
+    if not (name_census or attr_census):
+        return findings
+    for (rel, qual), (fn, _cls) in graph.funcs.items():
+        parents = None
+        for node in astutil.scope_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = astutil.terminal_attr(node)
+            if isinstance(node.func, ast.Name):
+                dset = name_census.get(callee)
+            else:
+                dset = attr_census.get(callee)
+            if not dset:
+                continue
+            if parents is None:
+                parents = astutil.enclosing_map(fn)
+            # The call's own assignment targets (rebinding counts as the
+            # donation-safe pattern) and loop context.
+            targets: Set[str] = set()
+            in_loop = False
+            cur = node
+            while cur in parents:
+                cur = parents[cur]
+                if isinstance(cur, (ast.For, ast.While)):
+                    in_loop = True
+                if isinstance(cur, ast.Assign):
+                    for t in cur.targets:
+                        for el in ([t.elts] if isinstance(
+                                t, (ast.Tuple, ast.List)) else [[t]]):
+                            targets.update(e.id for e in el
+                                           if isinstance(e, ast.Name))
+            donated_names = {node.args[p].id: p for p in dset
+                            if p < len(node.args)
+                            and isinstance(node.args[p], ast.Name)}
+            # use-after-donate
+            for n, p in donated_names.items():
+                if in_loop and n not in targets:
+                    stored_in_fn = any(
+                        isinstance(x, ast.Name) and x.id == n
+                        and isinstance(x.ctx, ast.Store)
+                        for x in astutil.scope_walk(fn))
+                    if not stored_in_fn:
+                        findings.append(Finding(
+                            "spmd-use-after-donate", rel, node.lineno,
+                            f"{qual}:{n}",
+                            f"`{n}` is donated at position {p} of "
+                            f"`{callee}` inside a loop but never rebound — "
+                            "the next iteration reads a donated buffer"))
+                    continue
+                loads_after = sorted(
+                    x.lineno for x in astutil.scope_walk(fn)
+                    if isinstance(x, ast.Name) and x.id == n
+                    and isinstance(x.ctx, ast.Load)
+                    and x.lineno > node.lineno)
+                stores = sorted(
+                    x.lineno for x in astutil.scope_walk(fn)
+                    if isinstance(x, ast.Name) and x.id == n
+                    and isinstance(x.ctx, ast.Store))
+                for ll in loads_after:
+                    if not any(node.lineno <= s <= ll for s in stores):
+                        findings.append(Finding(
+                            "spmd-use-after-donate", rel, node.lineno,
+                            f"{qual}:{n}",
+                            f"`{n}` is donated at position {p} of "
+                            f"`{callee}` but read again at line {ll} — "
+                            "a donated buffer is garbage on TPU"))
+                        break
+            # missed-donation: a buffer carried through the loop (arg AND
+            # assignment target) at a position the donate set omits.
+            if in_loop:
+                for p, a in enumerate(node.args):
+                    if (isinstance(a, ast.Name) and a.id in targets
+                            and p not in dset
+                            and a.id not in donated_names):
+                        findings.append(Finding(
+                            "spmd-missed-donation", rel, node.lineno,
+                            f"{qual}:{a.id}",
+                            f"`{a.id}` is rebound through `{callee}` every "
+                            f"iteration but position {p} is not in its "
+                            "donate_argnums — the old buffer survives the "
+                            "step (double memory for carried state)"))
+    return findings
+
+
+def analyze(ctx: Context) -> List[Finding]:
+    graph = astutil.CallGraph(ctx.modules)
+    findings = _coverage_findings(ctx)
+    findings += _axis_findings(ctx, graph)
+    findings += _donation_findings(ctx, graph)
+    return findings

@@ -1,0 +1,438 @@
+"""Wire-message schema drift invariants (phase 3).
+
+The framed-TCP protocol has no IDL: serializers write header dicts,
+parsers read them, and nothing checks the two sides name the same keys.
+Three drift surfaces, each with both directions checked:
+
+  * Header keys. Within the wire plane (``runtime/messages.py``, ``net.py``,
+    ``transport.py``, ``errors.py``, ``serving/gateway.py``,
+    ``scheduling/registry.py``) every key WRITTEN into a header-shaped dict
+    (a dict literal carrying a ``verb`` key, a subscript store on a
+    header-named variable, or a ``dict(hdr, k=...)`` augmentation) must be
+    READ somewhere in the plane (``h["k"]`` / ``h.get("k")`` /
+    ``h.pop("k")`` / ``"k" in h``), and vice versa:
+      - ``wire-write-never-read``: a serializer ships a key no parser
+        looks at — dead weight at best, a misspelled contract at worst.
+      - ``wire-read-never-written``: a parser expects a key no serializer
+        produces — the read only ever sees its default.
+  * Registry records. ``REC_FIELDS`` is the wire schema for
+    ``ServerRecord``; ``rec_to_dict``/``dict_to_rec`` and every gossip /
+    mirror / peers-cache consumer index records by those names.
+      - ``rec-field-unknown``: a REC_FIELDS entry that is not a
+        ServerRecord dataclass field (ships garbage via getattr).
+      - ``rec-field-unshipped``: a dataclass field absent from REC_FIELDS
+        (silently dropped at serialization — baseline it with the reason
+        when the drop is deliberate, e.g. monotonic-clock timestamps).
+      - ``rec-key-unknown``: a record consumer (a subscript/.get on a
+        variable named ``rec``/``record``/``nxt``) reads a key that is
+        neither a REC_FIELDS name nor a transit augmentation
+        (``dict(rec_to_dict(r), age_s=...)`` keywords).
+  * The protocol doc. ``dispatch.py`` checks verbs only; the per-hop
+    request header (everything ``_request_header`` writes plus the stamps
+    callers add to its result, e.g. ``relay_to``) must match the
+    "Per-hop header fields" table in docs/PROTOCOL.md:
+      - ``proto-field-undocumented``: a shipped header key with no
+        backticked table row.
+      - ``proto-field-unknown``: a documented key the code never ships.
+      - ``proto-header-table-missing``: the table itself is absent while
+        per-hop keys exist.
+
+Precision notes. Key extraction is variable-NAME-based: only dicts held in
+conventionally named variables (``hdr``/``header``/``h``/``resp``/...)
+count, so ordinary dict traffic elsewhere in the plane cannot pollute the
+schema. Both sides share the blind spots symmetrically — a gossip envelope
+accessed via ``w[...]`` is invisible to the write AND read censuses, so
+symmetric idioms cannot produce one-sided drift findings. Keys only ever
+built dynamically are invisible; that is the accepted precision cost of a
+no-import analyzer. Anchors are the key names, so baselines survive
+serializer refactors.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import astutil
+from .core import Context, Finding
+
+# The wire plane. Fixture trees (no such modules) fall back to the whole
+# tree so seeded-violation packages exercise every rule.
+WIRE_SUFFIXES = (
+    "runtime/messages.py", "runtime/net.py", "runtime/transport.py",
+    "runtime/errors.py", "serving/gateway.py", "scheduling/registry.py",
+)
+
+# Conventional header-dict variable names on each side. A name appearing
+# in both sets is fine — many functions both read and re-ship a header.
+HEADER_VARS = {"hdr", "hdr_out", "header", "h", "resp", "reply", "rh",
+               "frame"}
+
+# Modules whose ad-hoc reads (``reg._rpc(...).get("firings")``, loops over
+# response rows) sanction a written key: the CLI is the client side of the
+# info/metrics verbs, so its consumption counts even though it does not
+# use header-named variables.
+READER_SUFFIXES = WIRE_SUFFIXES + ("main.py",)
+
+# Record-dict variable names at consumer sites (gossip rows, next_servers
+# hops, mirror snapshots).
+REC_VARS = {"rec", "record", "nxt"}
+
+_PROTO_SECTION_RE = re.compile(
+    r"^#+\s*Per-hop header fields\b.*?$", re.MULTILINE | re.IGNORECASE)
+_BACKTICK_RE = re.compile(r"`([A-Za-z0-9_.-]+)`")
+
+
+def _scope_modules(ctx: Context) -> List[astutil.Module]:
+    mods = [m for m in ctx.modules
+            if any(m.rel.endswith(s) for s in WIRE_SUFFIXES)]
+    return mods or list(ctx.modules)
+
+
+def _sub_key(node: ast.Subscript) -> Optional[str]:
+    sl = node.slice
+    if isinstance(sl, ast.Index):        # pragma: no cover — py<3.9 only
+        sl = sl.value
+    return astutil.str_const(sl)
+
+
+def _collect_header_traffic(mods: List[astutil.Module]):
+    """(writes, reads): key -> first (rel, line)."""
+    writes: Dict[str, Tuple[str, int]] = {}
+    reads: Dict[str, Tuple[str, int]] = {}
+
+    def w(key, rel, line):
+        writes.setdefault(key, (rel, line))
+
+    def r(key, rel, line):
+        reads.setdefault(key, (rel, line))
+
+    for mod in mods:
+        for node in ast.walk(mod.tree):
+            # Header-shaped dict literal: one carrying a "verb" key. Only
+            # its top-level keys count — nested payloads (e.g. the chunked
+            # sub-dict) have their own symmetric blind spot.
+            if isinstance(node, ast.Dict):
+                keys = [astutil.str_const(k) for k in node.keys
+                        if k is not None]
+                if "verb" in keys:
+                    for k in keys:
+                        if k is not None:
+                            w(k, mod.rel, node.lineno)
+            elif isinstance(node, ast.Subscript):
+                if not (isinstance(node.value, ast.Name)
+                        and node.value.id in HEADER_VARS):
+                    continue
+                key = _sub_key(node)
+                if key is None:
+                    continue
+                if isinstance(node.ctx, ast.Store):
+                    w(key, mod.rel, node.lineno)
+                elif isinstance(node.ctx, ast.Load):
+                    r(key, mod.rel, node.lineno)
+            elif isinstance(node, ast.Call):
+                f = node.func
+                # dict(hdr, k=...) augmentation — keyword names are writes.
+                if (isinstance(f, ast.Name) and f.id == "dict"
+                        and node.args
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in HEADER_VARS):
+                    for kw in node.keywords:
+                        if kw.arg:
+                            w(kw.arg, mod.rel, node.lineno)
+                # h.get("k") / h.pop("k") reads.
+                elif (isinstance(f, ast.Attribute)
+                      and f.attr in ("get", "pop")
+                      and isinstance(f.value, ast.Name)
+                      and f.value.id in HEADER_VARS and node.args):
+                    key = astutil.str_const(node.args[0])
+                    if key is not None:
+                        r(key, mod.rel, node.lineno)
+            elif isinstance(node, ast.Compare):
+                # "k" in header
+                key = astutil.str_const(node.left)
+                if (key is not None and len(node.ops) == 1
+                        and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                        and isinstance(node.comparators[0], ast.Name)
+                        and node.comparators[0].id in HEADER_VARS):
+                    r(key, mod.rel, node.lineno)
+    return writes, reads
+
+
+def _liberal_reads(mods: List[astutil.Module]) -> Set[str]:
+    """Every string key accessed through ANY expression (``x.get("k")``,
+    ``row["k"]``, ``"k" in view``) — the permissive census that sanctions
+    a write. Asymmetric on purpose: wire-write-never-read uses this so
+    ad-hoc client-side consumption counts, while wire-read-never-written
+    keeps the conservative header-variable census (a liberal read set
+    there would flag every dict access in the plane)."""
+    out: Set[str] = set()
+    for mod in mods:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load):
+                key = _sub_key(node)
+                if key is not None:
+                    out.add(key)
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in ("get", "pop") and node.args):
+                key = astutil.str_const(node.args[0])
+                if key is not None:
+                    out.add(key)
+            elif isinstance(node, ast.Compare):
+                key = astutil.str_const(node.left)
+                if (key is not None and len(node.ops) == 1
+                        and isinstance(node.ops[0], (ast.In, ast.NotIn))):
+                    out.add(key)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Registry record schema
+# ---------------------------------------------------------------------------
+
+def _registry_schema(ctx: Context):
+    """(rec_fields, rec_line, dataclass_fields, field_lines, rel) from the
+    module defining REC_FIELDS, or None."""
+    for mod in ctx.modules:
+        rec_fields: Optional[List[str]] = None
+        rec_line = 0
+        for node in mod.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "REC_FIELDS"
+                    and isinstance(node.value, (ast.Tuple, ast.List))):
+                vals = [astutil.str_const(e) for e in node.value.elts]
+                if all(v is not None for v in vals):
+                    rec_fields, rec_line = vals, node.lineno
+        if rec_fields is None:
+            continue
+        dc_fields: Dict[str, int] = {}
+        for node in mod.tree.body:
+            if (isinstance(node, ast.ClassDef)
+                    and node.name == "ServerRecord"):
+                for stmt in node.body:
+                    if (isinstance(stmt, ast.AnnAssign)
+                            and isinstance(stmt.target, ast.Name)):
+                        dc_fields[stmt.target.id] = stmt.lineno
+        return rec_fields, rec_line, dc_fields, mod.rel
+    return None
+
+
+def _transit_keys(ctx: Context) -> Set[str]:
+    """Keys added to a wire record in transit — legal for consumers to
+    read on top of REC_FIELDS. Two idioms: ``dict(rec_to_dict(r),
+    age_s=...)`` keywords, and subscript stores on a variable assigned
+    from a ``rec_to_dict``-ish call (``d = _r2d(rec); d["stats"] = ...``),
+    resolving import aliases so local renames still count."""
+    out: Set[str] = set()
+    for mod in ctx.modules:
+        aliases = astutil.import_aliases(mod.tree)
+
+        def _is_r2d(call: ast.AST) -> bool:
+            if not isinstance(call, ast.Call):
+                return False
+            name = astutil.terminal_attr(call) or ""
+            src = aliases.get(name, name)
+            return src.endswith("rec_to_dict")
+
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "dict" and node.args
+                    and _is_r2d(node.args[0])):
+                out.update(kw.arg for kw in node.keywords if kw.arg)
+        for _qual, _cls, fn in astutil.walk_functions(mod.tree):
+            r2d_vars = {
+                t.id
+                for node in astutil.scope_walk(fn)
+                if isinstance(node, ast.Assign) and _is_r2d(node.value)
+                for t in node.targets if isinstance(t, ast.Name)}
+            if not r2d_vars:
+                continue
+            for node in astutil.scope_walk(fn):
+                if (isinstance(node, ast.Subscript)
+                        and isinstance(node.ctx, ast.Store)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in r2d_vars):
+                    key = _sub_key(node)
+                    if key is not None:
+                        out.add(key)
+    return out
+
+
+def _rec_consumer_reads(ctx: Context) -> Dict[str, Tuple[str, int]]:
+    reads: Dict[str, Tuple[str, int]] = {}
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            key = None
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in REC_VARS):
+                key = _sub_key(node)
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "get"
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.value.id in REC_VARS and node.args):
+                key = astutil.str_const(node.args[0])
+            if key is not None:
+                reads.setdefault(key, (mod.rel, node.lineno))
+    return reads
+
+
+# ---------------------------------------------------------------------------
+# Per-hop header vs PROTOCOL.md
+# ---------------------------------------------------------------------------
+
+def _per_hop_keys(ctx: Context):
+    """Keys ``_request_header`` writes plus the stamps callers put on its
+    result: ``hdr = _request_header(...); hdr["relay_to"] = ...``.
+    Returns (keys -> first (rel, line), builder_rel) or None."""
+    builder = None
+    for mod in ctx.modules:
+        for qual, _cls, fn in astutil.walk_functions(mod.tree):
+            if qual.split(".")[-1] == "_request_header":
+                builder = (mod, fn)
+                break
+        if builder:
+            break
+    if builder is None:
+        return None
+    mod, fn = builder
+    keys: Dict[str, Tuple[str, int]] = {}
+    for node in astutil.scope_walk(fn):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                v = astutil.str_const(k) if k is not None else None
+                if v is not None:
+                    keys.setdefault(v, (mod.rel, node.lineno))
+        elif (isinstance(node, ast.Subscript)
+              and isinstance(node.ctx, ast.Store)):
+            v = _sub_key(node)
+            if v is not None:
+                keys.setdefault(v, (mod.rel, node.lineno))
+    # Caller-side stamps on variables assigned from _request_header(...).
+    for m in ctx.modules:
+        for _qual, _cls, f in astutil.walk_functions(m.tree):
+            tagged: Set[str] = set()
+            for node in astutil.scope_walk(f):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and (astutil.terminal_attr(node.value)
+                             == "_request_header")):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            tagged.add(t.id)
+            if not tagged:
+                continue
+            for node in astutil.scope_walk(f):
+                if (isinstance(node, ast.Subscript)
+                        and isinstance(node.ctx, ast.Store)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in tagged):
+                    v = _sub_key(node)
+                    if v is not None:
+                        keys.setdefault(v, (m.rel, node.lineno))
+    return keys, mod.rel
+
+
+def _doc_table_keys(ctx: Context) -> Optional[Dict[str, int]]:
+    """Backticked keys in the "Per-hop header fields" table rows, or None
+    when the section is absent."""
+    text = ctx.protocol_text
+    m = _PROTO_SECTION_RE.search(text)
+    if not m:
+        return None
+    out: Dict[str, int] = {}
+    start_line = text[:m.start()].count("\n") + 1
+    for off, line in enumerate(text[m.end():].splitlines()):
+        if line.startswith("#"):
+            break
+        if not line.lstrip().startswith("|"):
+            continue
+        first_cell = line.lstrip().lstrip("|").split("|", 1)[0]
+        for key in _BACKTICK_RE.findall(first_cell):
+            out.setdefault(key, start_line + 1 + off)
+    return out
+
+
+# ---------------------------------------------------------------------------
+
+def analyze(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    mods = _scope_modules(ctx)
+
+    writes, reads = _collect_header_traffic(mods)
+    readers = [m for m in ctx.modules
+               if any(m.rel.endswith(s) for s in READER_SUFFIXES)]
+    liberal = _liberal_reads(readers or list(ctx.modules))
+    for key in sorted(set(writes) - set(reads) - liberal):
+        rel, line = writes[key]
+        findings.append(Finding(
+            "wire-write-never-read", rel, line, key,
+            f"header key `{key}` is written by a serializer but never read "
+            "by any parser in the wire plane — dead weight or a misspelled "
+            "contract"))
+    for key in sorted(set(reads) - set(writes)):
+        rel, line = reads[key]
+        findings.append(Finding(
+            "wire-read-never-written", rel, line, key,
+            f"header key `{key}` is read by a parser but never written by "
+            "any serializer in the wire plane — the read only ever sees "
+            "its default"))
+
+    schema = _registry_schema(ctx)
+    if schema is not None:
+        rec_fields, rec_line, dc_fields, rel = schema
+        for f in rec_fields:
+            if f not in dc_fields:
+                findings.append(Finding(
+                    "rec-field-unknown", rel, rec_line, f,
+                    f"REC_FIELDS names `{f}` but ServerRecord has no such "
+                    "field — rec_to_dict will crash or ship garbage"))
+        for f, line in dc_fields.items():
+            if f not in rec_fields:
+                findings.append(Finding(
+                    "rec-field-unshipped", rel, line, f,
+                    f"ServerRecord field `{f}` is absent from REC_FIELDS — "
+                    "it is silently dropped at serialization (baseline "
+                    "with the reason if deliberate)"))
+        legal = set(rec_fields) | _transit_keys(ctx)
+        for key, (rrel, line) in sorted(_rec_consumer_reads(ctx).items()):
+            if key not in legal:
+                findings.append(Finding(
+                    "rec-key-unknown", rrel, line, key,
+                    f"record consumer reads `{key}` which is neither a "
+                    "REC_FIELDS name nor a transit augmentation — it can "
+                    "never be present on a wire record"))
+
+    hop = _per_hop_keys(ctx)
+    if hop is not None:
+        keys, builder_rel = hop
+        doc = _doc_table_keys(ctx)
+        if doc is None:
+            findings.append(Finding(
+                "proto-header-table-missing", builder_rel, 1,
+                "per-hop-header-fields",
+                "docs/PROTOCOL.md has no 'Per-hop header fields' section — "
+                "the per-hop request header has no documented contract"))
+        else:
+            for key in sorted(set(keys) - set(doc)):
+                rel, line = keys[key]
+                findings.append(Finding(
+                    "proto-field-undocumented", rel, line, key,
+                    f"per-hop header key `{key}` is shipped by "
+                    "_request_header (or stamped on its result) but has no "
+                    "backticked row in PROTOCOL.md's per-hop table"))
+            for key in sorted(set(doc) - set(keys)):
+                findings.append(Finding(
+                    "proto-field-unknown", "docs/PROTOCOL.md", doc[key],
+                    key,
+                    f"PROTOCOL.md's per-hop table documents `{key}` but "
+                    "the code never ships that key"))
+    return findings

@@ -78,7 +78,8 @@ SHARDS = [
     # in-process swarms — grouped so their compiles share one process
     # without crowding the engine shards)
     ["test_events.py", "test_faults.py", "test_gossip.py",
-     "test_graftlint.py", "test_graftlint_phase2.py", "test_profiling.py",
+     "test_graftlint.py", "test_graftlint_phase2.py",
+     "test_graftlint_phase3.py", "test_profiling.py",
      "test_telemetry.py"],
 ]
 
@@ -92,6 +93,23 @@ def main() -> int:
     t0 = time.time()
     failures = []
     parity_reruns = 0
+
+    # Fast pre-shard gate: lint only the files changed vs HEAD (subsecond
+    # on a typical diff) so a fresh violation fails in seconds instead of
+    # after the ~15-minute shard loop. The FULL lint still runs as the
+    # final shard — --changed-only scopes reporting, it is not the gate of
+    # record (docs/STATIC_ANALYSIS.md, "CI recipe").
+    print("[pre] python -m scripts.graftlint --changed-only", flush=True)
+    t = time.time()
+    rc = subprocess.call(
+        [sys.executable, "-m", "scripts.graftlint", "--changed-only"],
+        cwd=REPO)
+    print(f"[pre] exit={rc} in {time.time() - t:.1f}s", flush=True)
+    if rc != 0:
+        print("FULL SUITE: aborted — graftlint --changed-only failed; "
+              "fix or baseline the new findings before the shard loop")
+        return 1
+
     for i, files in enumerate(SHARDS, 1):
         missing = [f for f in files
                    if not os.path.exists(os.path.join(REPO, "tests", f))]

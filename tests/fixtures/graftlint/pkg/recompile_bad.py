@@ -1,0 +1,72 @@
+"""Seeded jit recompilation hazards (phase 3 positive controls).
+
+Every recompile-* rule fires here; the sanctioned shapes (a returned
+wrapper, a static position, a config attribute set once) prove the rules
+stay quiet on the fixes. NEVER imported — parsed only.
+"""
+
+import jax
+
+
+def _impl(x, n, pad):
+    return x
+
+
+_step = jax.jit(_impl, static_argnums=(2,), static_argnames=("bucket",))
+
+
+def eager_jit(x):
+    # recompile-jit-per-call: the wrapper dies with the statement.
+    return jax.jit(_impl)(x, 0, 0)
+
+
+def local_wrapper(x):
+    # recompile-jit-per-call (local form): g is called but never escapes,
+    # so the wrapper is rebuilt on every call of local_wrapper.
+    g = jax.jit(_impl)
+    return g(x, 0, 0)
+
+
+def cached_build():
+    # Sanctioned: the wrapper escapes — the caller keeps it.
+    fn = jax.jit(_impl)
+    return fn
+
+
+def retrace_storm(xs):
+    out = []
+    for x in xs:
+        # recompile-jit-in-loop: a fresh callable is wrapped per iteration.
+        f = jax.jit(lambda v: v * 2)
+        out.append(f(x))
+    return out
+
+
+def hot_path(tokens, x):
+    n = len(tokens)
+    # recompile-dynamic-scalar: n is len()-derived and position 1 is not
+    # static — every distinct length is a fresh trace.
+    return _step(x, n, 0)
+
+
+def bucketed_path(tokens, x):
+    n = len(tokens)
+    # Sanctioned: position 2 is in static_argnums (retrace is the point),
+    # and `bucket` is in static_argnames.
+    return _step(x, 0, n, bucket=n)
+
+
+class Decoder:
+    def __init__(self, scale):
+        self.scale = scale
+        self.offset = 1.0
+        self.step = jax.jit(self._step)
+
+    def _step(self, x):
+        # recompile-self-closure: `scale` is reassigned outside __init__,
+        # so the trace bakes in a stale value. `offset` is set once in
+        # __init__ (config-stable) and must NOT fire.
+        return x * self.scale + self.offset
+
+    def retune(self, s):
+        self.scale = s

@@ -1,0 +1,88 @@
+"""Seeded SPMD sharding-discipline violations (phase 3 positive controls).
+
+Every spmd-* rule fires here; the clean shapes (a covered leaf, a
+reasoned replicated entry, a shard_map-reachable collective, a rebinding
+donation caller) prove the rules don't fire on the sanctioned idioms.
+NEVER imported — parsed only.
+"""
+
+import jax
+
+
+def init_layer_params(key, cfg):
+    p = {
+        "attn": {"wq": 1, "wk": 1, "wv": 1, "wo": 1},
+        "mlp": {"wi": 1, "wo": 1, "ln": 1},
+        # spmd-catchall-leaf: matches no rule and no REPLICATED_LEAVES row.
+        "rope": {"freqs": 1},
+    }
+    return p
+
+
+def tp_partition_rules(cfg, axis="tp"):
+    attn = (
+        (r"attn/(wq|wk|wv)$", ("col", axis)),
+        # spmd-rule-shadowed: the rule above always matches attn/wq first.
+        (r"attn/wq$", ("shadowed",)),
+        # spmd-rule-shadowed (dead): no corpus leaf matches at all.
+        (r"attn/ghost$", ("dead",)),
+        (r"attn/wo$", ("row", axis)),
+    )
+    mlp = (
+        (r"mlp/(wi|wo)$", ("col", axis)),
+    )
+    return (*attn, *mlp, (r".*", ()))
+
+
+REPLICATED_LEAVES = (
+    # spmd-replicated-no-reason: explicit replication with the why missing.
+    (r"mlp/ln$", ""),
+)
+
+
+# --- axis binding ----------------------------------------------------------
+
+def _shard_body(x):
+    # Reachable from the shard_map below: sanctioned, must NOT fire.
+    return jax.lax.psum(x, "tp")
+
+
+def build_sharded(mesh):
+    return jax.shard_map(_shard_body, mesh=mesh, in_specs=None,
+                         out_specs=None)
+
+
+def orphan_collective(x):
+    # spmd-axis-unbound: never reachable from any shard_map/pmap root.
+    return jax.lax.psum(x, "tp")
+
+
+# --- donation discipline ---------------------------------------------------
+
+def _step_impl(cache, x):
+    return cache + x
+
+
+step = jax.jit(_step_impl, donate_argnums=(0,))
+step2 = jax.jit(_step_impl, donate_argnums=(1,))
+
+
+def leaky_reuse(cache, x):
+    out = step(cache, x)
+    # spmd-use-after-donate: cache was donated to `step` above.
+    return out + cache
+
+
+def decode_no_donate(cache, xs):
+    for x in xs:
+        # spmd-missed-donation: cache is rebound every iteration but
+        # position 0 is not in step2's donate set.
+        cache = step2(cache, x)
+    return cache
+
+
+def decode_donating(cache, xs):
+    for x in xs:
+        # Sanctioned: the donated position is the rebound carry.
+        cache = step(cache, x)
+    return cache

@@ -1,0 +1,59 @@
+"""Seeded wire-schema drift (phase 3 positive controls).
+
+Every wire_schema rule fires here. The fixture tree has no
+docs/PROTOCOL.md, so defining a ``_request_header`` also exercises
+``proto-header-table-missing``. Sanctioned shapes (a key written AND
+read, a transit-augmented record key) prove the checks are two-sided.
+NEVER imported — parsed only.
+"""
+
+# rec-field-unknown: "ghost" is not a ServerRecord field.
+REC_FIELDS = ("peer", "start_block", "ghost")
+
+
+class ServerRecord:
+    peer: str
+    start_block: int
+    # rec-field-unshipped: absent from REC_FIELDS, silently dropped.
+    secret: float
+
+
+def rec_to_dict(r):
+    return {f: getattr(r, f) for f in REC_FIELDS}
+
+
+def _request_header(session_id):
+    return {"verb": "step", "session_id": session_id}
+
+
+def send_step(sock, session_id):
+    hdr = _request_header(session_id)
+    # Stamped per-hop key: with no PROTOCOL.md table in the fixture tree
+    # this (plus the builder above) yields proto-header-table-missing.
+    hdr["relay_hint"] = "fixture"
+    return hdr
+
+
+def serialize_reply():
+    # wire-write-never-read: nothing anywhere reads "orphan_out".
+    return {"verb": "reply", "session_id": "s", "orphan_out": 1}
+
+
+def parse_reply(hdr):
+    sid = hdr["session_id"]
+    verb = hdr.get("verb")
+    # wire-read-never-written: no serializer ships "never_sent".
+    missing = hdr.get("never_sent")
+    return sid, verb, missing
+
+
+def publish(r):
+    return dict(rec_to_dict(r), age_s=0.5)
+
+
+def consume(rec):
+    ok = rec["peer"]
+    age = rec.get("age_s")          # transit augmentation: sanctioned
+    # rec-key-unknown: neither a REC_FIELDS name nor a transit key.
+    bad = rec["not_a_field"]
+    return ok, age, bad

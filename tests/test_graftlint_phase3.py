@@ -1,0 +1,277 @@
+"""graftlint phase 3: SPMD sharding coverage, jit recompilation hazards,
+and wire-schema drift.
+
+Same three layers as the earlier graftlint suites (docs/STATIC_ANALYSIS.md):
+  1. every new rule FIRES on the seeded fixtures (pkg/spmd_bad.py,
+     pkg/recompile_bad.py, pkg/wire_bad.py) and the sanctioned shapes next
+     to each violation stay quiet;
+  2. the real package is CLEAN for the three new families in isolation,
+     so a failure names the family (the ALL_ANALYZERS full-tree gate in
+     test_graftlint.py already covers them jointly);
+  3. the real findings fixed when these analyzers first ran stay fixed —
+     their keys must never reappear — and the two schema artifacts the
+     wire family validates (REPLICATED_LEAVES, the PROTOCOL.md per-hop
+     table) stay in sync with the code in both directions.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from scripts.graftlint import (  # noqa: E402
+    Baseline, build_context, run_analyzers,
+)
+
+FIXTURES = REPO / "tests" / "fixtures" / "graftlint"
+PKG = ("global_capstone_design_distributed_inference_of_llms"
+       "_over_the_internet_tpu")
+FAMILIES = ["spmd", "recompile", "wire_schema"]
+
+
+# ---------------------------------------------------------------------------
+# 1. Fixtures: every new rule provably fires
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fixture_findings():
+    ctx = build_context(FIXTURES, pkg=FIXTURES / "pkg")
+    return {f.key for f in run_analyzers(ctx, FAMILIES)}
+
+
+def test_fixture_catchall_leaf_fires(fixture_findings):
+    assert "spmd-catchall-leaf:pkg/spmd_bad.py:rope/freqs" in fixture_findings
+
+
+def test_fixture_covered_leaves_are_clean(fixture_findings):
+    for leaf in ("attn/wq", "attn/wo", "mlp/wi", "mlp/ln"):
+        assert (f"spmd-catchall-leaf:pkg/spmd_bad.py:{leaf}"
+                not in fixture_findings), leaf
+
+
+def test_fixture_replicated_no_reason_fires(fixture_findings):
+    assert ("spmd-replicated-no-reason:pkg/spmd_bad.py:mlp/ln$"
+            in fixture_findings)
+
+
+def test_fixture_rule_shadowing_fires(fixture_findings):
+    # Shadowed (matches, never first) and dead (matches nothing) variants.
+    assert "spmd-rule-shadowed:pkg/spmd_bad.py:attn/wq$" in fixture_findings
+    assert ("spmd-rule-shadowed:pkg/spmd_bad.py:attn/ghost$"
+            in fixture_findings)
+
+
+def test_fixture_live_rules_are_clean(fixture_findings):
+    for rx in (r"attn/(wq|wk|wv)$", r"attn/wo$", r"mlp/(wi|wo)$"):
+        assert (f"spmd-rule-shadowed:pkg/spmd_bad.py:{rx}"
+                not in fixture_findings), rx
+
+
+def test_fixture_unbound_axis_fires(fixture_findings):
+    assert ("spmd-axis-unbound:pkg/spmd_bad.py:orphan_collective:psum:tp"
+            in fixture_findings)
+
+
+def test_fixture_shard_mapped_collective_is_clean(fixture_findings):
+    hits = [k for k in fixture_findings
+            if k.startswith("spmd-axis-unbound") and "_shard_body" in k]
+    assert not hits, hits
+
+
+def test_fixture_use_after_donate_fires(fixture_findings):
+    assert ("spmd-use-after-donate:pkg/spmd_bad.py:leaky_reuse:cache"
+            in fixture_findings)
+
+
+def test_fixture_missed_donation_fires(fixture_findings):
+    assert ("spmd-missed-donation:pkg/spmd_bad.py:decode_no_donate:cache"
+            in fixture_findings)
+
+
+def test_fixture_rebinding_donation_caller_is_clean(fixture_findings):
+    hits = [k for k in fixture_findings if "decode_donating" in k]
+    assert not hits, hits
+
+
+def test_fixture_jit_per_call_fires(fixture_findings):
+    # Both forms: immediate invoke and called-but-never-escapes local.
+    assert ("recompile-jit-per-call:pkg/recompile_bad.py:eager_jit"
+            in fixture_findings)
+    assert ("recompile-jit-per-call:pkg/recompile_bad.py:local_wrapper:g"
+            in fixture_findings)
+
+
+def test_fixture_escaping_wrapper_is_clean(fixture_findings):
+    hits = [k for k in fixture_findings if "cached_build" in k]
+    assert not hits, hits
+
+
+def test_fixture_jit_in_loop_fires(fixture_findings):
+    assert ("recompile-jit-in-loop:pkg/recompile_bad.py:retrace_storm"
+            in fixture_findings)
+
+
+def test_fixture_dynamic_scalar_fires(fixture_findings):
+    assert ("recompile-dynamic-scalar:pkg/recompile_bad.py:hot_path:_step:1"
+            in fixture_findings)
+
+
+def test_fixture_static_positions_are_clean(fixture_findings):
+    hits = [k for k in fixture_findings if "bucketed_path" in k]
+    assert not hits, hits
+
+
+def test_fixture_self_closure_fires(fixture_findings):
+    assert ("recompile-self-closure:pkg/recompile_bad.py:Decoder._step:scale"
+            in fixture_findings)
+
+
+def test_fixture_init_only_attr_is_clean(fixture_findings):
+    assert ("recompile-self-closure:pkg/recompile_bad.py:Decoder._step:"
+            "offset" not in fixture_findings)
+
+
+def test_fixture_header_drift_fires(fixture_findings):
+    assert ("wire-write-never-read:pkg/wire_bad.py:orphan_out"
+            in fixture_findings)
+    assert ("wire-read-never-written:pkg/wire_bad.py:never_sent"
+            in fixture_findings)
+
+
+def test_fixture_round_tripped_key_is_clean(fixture_findings):
+    for rule in ("wire-write-never-read", "wire-read-never-written"):
+        assert f"{rule}:pkg/wire_bad.py:session_id" not in fixture_findings
+
+
+def test_fixture_rec_schema_drift_fires(fixture_findings):
+    assert "rec-field-unknown:pkg/wire_bad.py:ghost" in fixture_findings
+    assert "rec-field-unshipped:pkg/wire_bad.py:secret" in fixture_findings
+    assert "rec-key-unknown:pkg/wire_bad.py:not_a_field" in fixture_findings
+
+
+def test_fixture_transit_augmentation_is_sanctioned(fixture_findings):
+    assert "rec-key-unknown:pkg/wire_bad.py:age_s" not in fixture_findings
+
+
+def test_fixture_missing_proto_table_fires(fixture_findings):
+    # The fixture tree has no docs/PROTOCOL.md, so the per-hop builder in
+    # wire_bad.py has no documented contract.
+    assert ("proto-header-table-missing:pkg/wire_bad.py:"
+            "per-hop-header-fields" in fixture_findings)
+
+
+# ---------------------------------------------------------------------------
+# 2. The real tree: the new families alone report nothing unbaselined
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def real_tree():
+    ctx = build_context(REPO)
+    findings = run_analyzers(ctx, FAMILIES)
+    baseline = Baseline.load(REPO / "graftlint_baseline.json")
+    return findings, baseline
+
+
+def test_real_tree_new_families_clean(real_tree):
+    findings, baseline = real_tree
+    new, _, _ = baseline.split(findings)
+    assert not new, "new phase-3 findings:\n" + "\n".join(
+        f.render() for f in new)
+
+
+def test_real_tree_proto_table_in_sync(real_tree):
+    """The PROTOCOL.md per-hop table matches _request_header (plus caller
+    stamps) in BOTH directions — never baselined, always fixed forward."""
+    findings, _ = real_tree
+    drift = [f for f in findings
+             if f.rule in ("proto-field-undocumented", "proto-field-unknown",
+                           "proto-header-table-missing")]
+    assert not drift, "\n".join(f.render() for f in drift)
+
+
+def test_real_tree_sharding_coverage_holds(real_tree):
+    """Every model leaf is a sharding decision: rule-matched or in
+    REPLICATED_LEAVES with a reason. Also never baselined."""
+    findings, _ = real_tree
+    drift = [f for f in findings
+             if f.rule in ("spmd-catchall-leaf", "spmd-replicated-no-reason",
+                           "spmd-rule-shadowed")]
+    assert not drift, "\n".join(f.render() for f in drift)
+
+
+# ---------------------------------------------------------------------------
+# 3. Regression pins: triage results of the first phase-3 run stay fixed
+# ---------------------------------------------------------------------------
+
+# Keys that fired during the initial full-tree run and were fixed forward
+# (not baselined). If any reappears, a fix regressed: the REPLICATED_LEAVES
+# table stopped covering the norm/bias/window leaves, the fori_loop `tick`
+# bodies lost their reference-edge reachability, or the decorator-
+# application jit idiom got misread as an immediate invoke again.
+FIXED_KEYS = [
+    f"spmd-catchall-leaf:{PKG}/models/transformer.py:ln1/w",
+    f"spmd-catchall-leaf:{PKG}/models/transformer.py:attn/bo",
+    f"spmd-catchall-leaf:{PKG}/models/transformer.py:mlp/bo",
+    f"spmd-catchall-leaf:{PKG}/models/transformer.py:window",
+    f"spmd-axis-unbound:{PKG}/parallel/ring_decode.py:"
+    "_ring_body.body.tick:ppermute:stage",
+    f"recompile-jit-per-call:{PKG}/parallel/tensor_parallel.py:"
+    "make_tp_stage_fn.build",
+    f"proto-header-table-missing:{PKG}/runtime/net.py:per-hop-header-fields",
+]
+
+
+def test_fixed_findings_stay_fixed(real_tree):
+    findings, _ = real_tree
+    keys = {f.key for f in findings}
+    back = [k for k in keys if k in FIXED_KEYS]
+    assert not back, f"previously fixed findings reappeared: {back}"
+
+
+def test_replicated_leaves_reasons_nonempty():
+    """The artifact the spmd family leans on: every REPLICATED_LEAVES row
+    carries a usable regex and a written reason."""
+    import re as _re
+
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.parallel import (  # noqa: E501
+        tensor_parallel as tp,
+    )
+
+    assert tp.REPLICATED_LEAVES, "registry emptied"
+    for rx, reason in tp.REPLICATED_LEAVES:
+        _re.compile(rx)
+        assert reason.strip(), rx
+    # The registry rows must not overlap the sharded rules: a leaf that IS
+    # rule-matched never consults the table, so an overlapping row would
+    # be dead documentation.
+    from global_capstone_design_distributed_inference_of_llms_over_the_internet_tpu.models.config import (  # noqa: E501
+        ModelConfig,
+    )
+    for moe in (False, True):
+        cfg = ModelConfig(
+            model_type="mixtral" if moe else "llama",
+            num_layers=2, hidden_size=8, intermediate_size=16, num_heads=2,
+            num_kv_heads=2, vocab_size=32,
+            num_experts=4 if moe else 0)
+        rules = [r for r, _s in tp.tp_partition_rules(cfg)[:-1]]
+        for sample in ("ln1/w", "attn/bo", "mlp/bo", "window"):
+            assert not any(_re.search(r, sample) for r in rules), (
+                moe, sample)
+
+
+# ---------------------------------------------------------------------------
+# 4. CLI surface: the new families ride the same driver
+# ---------------------------------------------------------------------------
+
+def test_cli_new_families_selectable():
+    proc = subprocess.run(
+        [sys.executable, "-m", "scripts.graftlint",
+         "--analyzer", "spmd", "--analyzer", "recompile",
+         "--analyzer", "wire_schema"],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "spmd" in proc.stdout and "wire_schema" in proc.stdout
